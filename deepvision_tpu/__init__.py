@@ -1,0 +1,26 @@
+"""deepvision_tpu — a TPU-native (JAX/XLA/Pallas/pjit) deep-vision framework.
+
+A ground-up re-design of the capabilities of `dotdotdotcg/deep-vision`
+(an educational CV model zoo: classification / detection / pose / GANs)
+as ONE shared library instead of per-model copy-paste:
+
+- ``core``     : mesh + sharding setup, precision policy, PRNG discipline,
+                 train-step compilation (jit/pjit with donated args).
+- ``data``     : host-side input pipelines (tf.data + pure-python TFRecord
+                 codec), dataset builders, augmentation library.
+- ``models``   : Flax modules for every reference network family.
+- ``ops``      : jit-able tensor ops (IoU, NMS, LRN, label encoders) and
+                 Pallas TPU kernels for the hot spots.
+- ``losses``   : pure-function losses (CE/top-k, YOLO multiscale, heatmap
+                 MSE, GAN losses).
+- ``parallel`` : data/spatial/model parallelism over a jax.sharding.Mesh.
+- ``train``    : Trainer, optimizers, LR schedules, checkpointing (Orbax),
+                 metric loggers.
+- ``convert``  : PyTorch/TF checkpoint import + layer-for-layer activation
+                 diffing against the reference implementations.
+
+Reference behavior is cited throughout as ``ref: <file:line>`` meaning a
+path under the upstream `deep-vision` repo.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,27 @@
+from deepvision_tpu.core.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    create_mesh,
+    data_sharding,
+    replicated_sharding,
+    shard_batch,
+)
+from deepvision_tpu.core.precision import Precision, get_precision
+from deepvision_tpu.core.prng import KeySeq, fold_host, split_like
+from deepvision_tpu.core.step import compile_train_step, TrainStepFn
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_MODEL",
+    "create_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "Precision",
+    "get_precision",
+    "KeySeq",
+    "fold_host",
+    "split_like",
+    "compile_train_step",
+    "TrainStepFn",
+]

@@ -1,0 +1,75 @@
+"""Device mesh + sharding helpers.
+
+The reference scales only via single-host data parallelism
+(``nn.DataParallel`` — ref: ResNet/pytorch/train.py:352-355;
+``tf.distribute.MirroredStrategy`` — ref: YOLO/tensorflow/train.py:281-296).
+Here the equivalent is a ``jax.sharding.Mesh`` with a ``data`` axis (and an
+optional ``model`` axis for tensor/spatial parallelism, which the reference
+never had but this framework supports first-class). XLA inserts the
+all-reduce collectives over ICI/DCN; there is no user-visible NCCL analog.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+
+def create_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axis_names: tuple[str, str] = (AXIS_DATA, AXIS_MODEL),
+) -> Mesh:
+    """Build a 2-D ``(data, model)`` mesh over the available devices.
+
+    ``n_data=None`` uses every device not consumed by the model axis.
+    A single-chip mesh is a valid degenerate case (the reference's
+    "CPU or single GPU also works" story — ref: YOLO/tensorflow/README.md:2).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        if len(devices) % n_model:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by model axis {n_model}"
+            )
+        n_data = len(devices) // n_model
+    need = n_data * n_model
+    if need > len(devices):
+        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, "
+                         f"have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_data, n_model)
+    return Mesh(grid, axis_names)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
+    """Batch-dim sharding for an activation of rank ``ndim`` (NHWC default)."""
+    spec = P(AXIS_DATA, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host-local pytree of numpy arrays onto the mesh, batch-sharded.
+
+    Single-process equivalent of
+    ``jax.make_array_from_process_local_data``; the multi-host path goes
+    through :mod:`deepvision_tpu.data.device_put` which shards per-host
+    ``tf.data`` output (the reference's ``experimental_distribute_dataset``
+    analog — ref: YOLO/tensorflow/train.py:291-294).
+    """
+    def put(x):
+        x = np.asarray(x)
+        return jax.device_put(x, data_sharding(mesh, x.ndim))
+
+    return jax.tree_util.tree_map(put, batch)

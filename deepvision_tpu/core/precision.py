@@ -1,0 +1,44 @@
+"""Numerics policy: bf16 compute on the MXU, f32 params/reductions.
+
+The reference trains everything in f32 (cuDNN-era defaults). On TPU the MXU
+natively multiplies bf16 with f32 accumulation, so the framework-wide policy
+is: parameters and optimizer state in f32, matmul/conv inputs cast to bf16,
+batch-norm statistics and losses in f32. Models take ``dtype``/``param_dtype``
+in the Flax convention so tests can force full f32 for parity checks against
+the PyTorch reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    # BN statistics / softmax / loss accumulation dtype.
+    reduce_dtype: jnp.dtype = jnp.float32
+
+    def cast_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+_F32 = Precision(compute_dtype=jnp.float32)
+_BF16 = Precision()
+
+
+def get_precision(name: str = "bf16") -> Precision:
+    """``bf16`` (TPU default) or ``f32`` (parity testing)."""
+    if name in ("bf16", "bfloat16", "mixed"):
+        return _BF16
+    if name in ("f32", "float32", "full"):
+        return _F32
+    raise ValueError(f"unknown precision policy {name!r}")

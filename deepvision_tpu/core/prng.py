@@ -1,0 +1,45 @@
+"""PRNG key discipline.
+
+The reference relies on framework-global RNG (torch/tf seeds). JAX requires
+explicit keys; the rules here are:
+
+- one root key per run, derived from the integer seed in the model config;
+- ``fold_host`` folds in the process index so multi-host data augmentation
+  streams are distinct;
+- ``KeySeq`` hands out one subkey per step — never reuse, never rely on
+  global state (replaces e.g. torch's implicit per-worker RNG in
+  ``DataLoader(num_workers=16)`` — ref: ResNet/pytorch/train.py:229-234).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_host(key: jax.Array) -> jax.Array:
+    return jax.random.fold_in(key, jax.process_index())
+
+
+def split_like(key: jax.Array, tree):
+    """One independent key per leaf of ``tree``."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree_util.tree_unflatten(treedef, list(keys))
+
+
+class KeySeq:
+    """Stateful host-side key sequence: ``next(seq)`` -> fresh subkey."""
+
+    def __init__(self, seed_or_key):
+        if isinstance(seed_or_key, int):
+            seed_or_key = jax.random.key(seed_or_key)
+        self._key = seed_or_key
+
+    def __next__(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def take(self, n: int) -> jax.Array:
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return jnp.stack(subs)

@@ -1,0 +1,3 @@
+from deepvision_tpu.data.mnist import load_mnist_idx, synthetic_mnist
+
+__all__ = ["load_mnist_idx", "synthetic_mnist"]

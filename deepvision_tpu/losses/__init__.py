@@ -1,0 +1,7 @@
+from deepvision_tpu.losses.classification import (
+    cross_entropy_loss,
+    softmax_cross_entropy,
+    topk_accuracy,
+)
+
+__all__ = ["cross_entropy_loss", "softmax_cross_entropy", "topk_accuracy"]

@@ -1,0 +1,48 @@
+"""Classification loss + top-k metrics.
+
+One canonical form: integer labels + logits (the PyTorch reference's
+``CrossEntropyLoss`` convention — ref: ResNet/pytorch/train.py:452). The TF
+reference instead bakes softmax into the model and uses
+``categorical_crossentropy`` on one-hots (ref:
+ResNet/tensorflow/models/resnet50.py:42, train.py:275-279); that asymmetry is
+normalized away here — all models emit logits, one-hot conversion happens in
+the loss.
+
+Top-1/top-5 metrics mirror ref: ResNet/pytorch/train.py:523-538.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits, labels, *, label_smoothing: float = 0.0):
+    """Mean CE over the batch. ``labels`` are int32 class ids."""
+    logits = logits.astype(jnp.float32)
+    if label_smoothing:
+        num_classes = logits.shape[-1]
+        onehot = jnp.eye(num_classes, dtype=jnp.float32)[labels]
+        losses = optax.softmax_cross_entropy(
+            logits, optax.smooth_labels(onehot, label_smoothing)
+        )
+    else:
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return losses.mean()
+
+
+# Alias used throughout the trainers.
+cross_entropy_loss = softmax_cross_entropy
+
+
+def topk_accuracy(logits, labels, ks=(1, 5)):
+    """dict of top-k accuracies (fractions in [0,1]).
+
+    ref: ResNet/pytorch/train.py:523-538 computes top-1/top-5 with
+    ``torch.topk``; same semantics here via a rank comparison (the true
+    class is in the top-k iff fewer than k classes score strictly higher).
+    """
+    logits = logits.astype(jnp.float32)
+    target_scores = jnp.take_along_axis(logits, labels[:, None], axis=-1)
+    rank = jnp.sum(logits > target_scores, axis=-1)
+    return {f"top{k}": jnp.mean((rank < k).astype(jnp.float32)) for k in ks}

@@ -1,0 +1,6 @@
+from deepvision_tpu.models.registry import get_model, list_models, register
+
+# Import for registration side effects.
+from deepvision_tpu.models import lenet  # noqa: F401
+
+__all__ = ["get_model", "list_models", "register"]

@@ -1,0 +1,79 @@
+"""Shared building blocks for the model zoo (NHWC, Flax linen).
+
+Layout is NHWC throughout — the TPU-native convolution layout — whereas the
+PyTorch reference is NCHW; the checkpoint converter (convert/torch_import.py)
+owns the transpose. Weight init helpers mirror the reference's documented
+choices (he-normal convs + BN gamma=1/beta=0 for ResNet —
+ref: ResNet/pytorch/models/resnet50.py:84-93; xavier convs for VGG —
+ref: VGG/pytorch/models/vgg16.py:113-119).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+he_normal = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+xavier_uniform = nn.initializers.xavier_uniform()
+
+
+class ConvBN(nn.Module):
+    """Conv → BatchNorm → optional activation, the zoo's workhorse block.
+
+    BN statistics are kept in f32 regardless of compute dtype; ``use_running``
+    follows linen's ``use_running_average`` convention and is threaded via
+    the ``train`` argument of the parent model.
+    """
+
+    features: int
+    kernel: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    padding: str | Sequence = "SAME"
+    groups: int = 1
+    use_bias: bool = False
+    act: Callable | None = nn.relu
+    kernel_init: Callable = he_normal
+    dtype: Dtype = jnp.float32
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding=self.padding,
+            feature_group_count=self.groups,
+            use_bias=self.use_bias,
+            kernel_init=self.kernel_init,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
+            dtype=jnp.float32,
+            name="bn",
+        )(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+def max_pool(x, window=(2, 2), strides=None, padding="VALID"):
+    return nn.max_pool(x, window, strides or window, padding)
+
+
+def avg_pool(x, window=(2, 2), strides=None, padding="VALID"):
+    return nn.avg_pool(x, window, strides or window, padding)
+
+
+def global_avg_pool(x):
+    """GAP over H, W — NHWC (B, H, W, C) -> (B, C), f32 accumulation."""
+    return jnp.mean(x.astype(jnp.float32), axis=(1, 2)).astype(x.dtype)

@@ -1,0 +1,54 @@
+"""LeNet-5 for MNIST.
+
+Capability parity with both reference variants:
+- PyTorch: tanh activations, average pooling, softmax head replacing the
+  paper's RBF output (ref: LeNet/pytorch/models/lenet5.py:8-67).
+- TF/Keras: sigmoid between pools (ref: LeNet/tensorflow/models/lenet5.py:7-34)
+  — selectable via ``activation="sigmoid"``.
+
+Input is a 32x32x1 image (MNIST 28x28 padded to 32 by the data pipeline, as
+the reference's loader does — ref: LeNet/pytorch/data_load.py:12-57).
+Outputs raw logits; softmax lives in the loss/eval code.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepvision_tpu.models import layers
+from deepvision_tpu.models.registry import register
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    activation: str = "tanh"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = {"tanh": nn.tanh, "sigmoid": nn.sigmoid}[self.activation]
+        conv = lambda f, name: nn.Conv(
+            f, (5, 5), padding="VALID", dtype=self.dtype, name=name
+        )
+        x = x.astype(self.dtype)
+        x = act(conv(6, "c1")(x))            # 32 -> 28
+        x = layers.avg_pool(x)               # 28 -> 14
+        x = act(conv(16, "c3")(x))           # 14 -> 10
+        x = layers.avg_pool(x)               # 10 -> 5
+        x = act(conv(120, "c5")(x))          # 5 -> 1
+        x = x.reshape((x.shape[0], -1))
+        x = act(nn.Dense(84, dtype=self.dtype, name="f6")(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="output")(x)
+        return x
+
+
+@register("lenet5")
+def _lenet5(**kw) -> LeNet5:
+    return LeNet5(**kw)
+
+
+@register("lenet5_tf")
+def _lenet5_tf(**kw) -> LeNet5:
+    kw.setdefault("activation", "sigmoid")
+    return LeNet5(**kw)

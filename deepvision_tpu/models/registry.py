@@ -1,0 +1,36 @@
+"""Model registry: name -> Flax module factory.
+
+Replaces the reference's per-trainer ``training_config`` model lookup
+(ref: ResNet/pytorch/train.py:541-562 argparse choices) with one global
+registry shared by the CLI, tests, converter, and benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(factory):
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate model name {name!r}")
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+    return factory(**kwargs)
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)

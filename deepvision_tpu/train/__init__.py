@@ -1,0 +1,3 @@
+from deepvision_tpu.train.state import TrainState, create_train_state
+
+__all__ = ["TrainState", "create_train_state"]

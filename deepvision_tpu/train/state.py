@@ -1,0 +1,67 @@
+"""TrainState: the one pytree that flows through the compiled step.
+
+Replaces the reference's checkpoint-dict-of-everything
+(``{'epoch','model','optimizer','scheduler','loggers'}`` —
+ref: ResNet/pytorch/train.py:417-428) with an immutable flax.struct dataclass
+holding params + BN batch_stats + optax optimizer state + step counter. The
+``loggers`` metric history stays host-side (train/loggers.py) and is saved
+next to the state by the Orbax checkpointer, preserving the reference's
+"curves live inside the checkpoint" workflow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    # Static (non-pytree) fields:
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads, *, batch_stats=None) -> "TrainState":
+        updates, new_opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=self.batch_stats if batch_stats is None else batch_stats,
+        )
+
+
+def create_train_state(
+    model,
+    tx: optax.GradientTransformation,
+    sample_input,
+    *,
+    rng: jax.Array | int = 0,
+    train_kwarg: bool = True,
+) -> TrainState:
+    """Initialize params/batch_stats from a sample batch and wrap with ``tx``."""
+    if isinstance(rng, int):
+        rng = jax.random.key(rng)
+    kwargs = {"train": False} if train_kwarg else {}
+    variables = model.init(rng, sample_input, **kwargs)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        apply_fn=model.apply,
+        tx=tx,
+    )

@@ -1,0 +1,77 @@
+"""Canonical pure step functions for classification models.
+
+The reference repeats this logic in every train.py (forward → CE → backward →
+step → metrics; ref: ResNet/pytorch/train.py:438-485 and validate :488-520).
+Here it is written once, as pure functions suitable for
+``core.step.compile_train_step``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deepvision_tpu.losses.classification import (
+    softmax_cross_entropy,
+    topk_accuracy,
+)
+from deepvision_tpu.train.state import TrainState
+
+
+def classification_train_step(
+    state: TrainState, batch: dict, key: jax.Array
+) -> tuple[TrainState, dict]:
+    """One SGD step on {'image','label'}; returns (new_state, metrics)."""
+    images, labels = batch["image"], batch["label"]
+
+    def loss_fn(params):
+        out, mutated = state.apply_fn(
+            {"params": params, "batch_stats": state.batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": key},
+        )
+        # Inception-style aux heads return (main, aux...) tuples; weight the
+        # aux losses 0.3 as the paper/reference do
+        # (ref: Inception/pytorch/train.py aux handling, models/inception_v1.py:92-113).
+        if isinstance(out, (tuple, list)):
+            main, *aux = out
+            loss = softmax_cross_entropy(main, labels)
+            for a in aux:
+                loss = loss + 0.3 * softmax_cross_entropy(a, labels)
+            logits = main
+        else:
+            logits = out
+            loss = softmax_cross_entropy(logits, labels)
+        return loss, (logits, mutated.get("batch_stats", state.batch_stats))
+
+    (loss, (logits, new_bs)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(state.params)
+    new_state = state.apply_gradients(grads, batch_stats=new_bs)
+    metrics = {"loss": loss, **topk_accuracy(logits, labels)}
+    return new_state, metrics
+
+
+def classification_eval_step(state: TrainState, batch: dict) -> dict:
+    images, labels = batch["image"], batch["label"]
+    variables: dict[str, Any] = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    logits = state.apply_fn(variables, images, train=False)
+    if isinstance(logits, (tuple, list)):
+        logits = logits[0]
+    loss = softmax_cross_entropy(logits, labels)
+    n = jnp.asarray(labels.shape[0], jnp.float32)
+    acc = topk_accuracy(logits, labels)
+    # Return sums so the host can aggregate exactly over a full epoch
+    # (the reference accumulates counts the same way,
+    # ref: ResNet/pytorch/train.py:488-520).
+    return {
+        "loss_sum": loss * n,
+        "count": n,
+        **{k: v * n for k, v in acc.items()},
+    }

@@ -1,0 +1,40 @@
+"""Test harness: force an 8-device CPU mesh before JAX initializes.
+
+The JAX analog of the reference's "MirroredStrategy degrades to CPU" testing
+story (ref: YOLO/tensorflow/README.md:2): every distributed code path runs
+against ``xla_force_host_platform_device_count=8`` virtual CPU devices, so
+sharding/collective correctness is exercised without TPU hardware.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep tf (host data pipelines) off any accelerator and quiet.
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import jax
+
+# Force CPU via jax.config: the session may pin JAX_PLATFORMS to a TPU
+# platform at interpreter startup, which overrides env-var changes made here.
+if not os.environ.get("DVT_TEST_ON_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from deepvision_tpu.core import create_mesh
+
+    return create_mesh(8, 1)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
