@@ -1,6 +1,14 @@
 from deepvision_tpu.models.registry import get_model, list_models, register
 
-# Import for registration side effects.
-from deepvision_tpu.models import lenet  # noqa: F401
+# Imports for registration side effects.
+from deepvision_tpu.models import (  # noqa: F401
+    alexnet,
+    inception,
+    lenet,
+    mobilenet,
+    resnet,
+    shufflenet,
+    vgg,
+)
 
 __all__ = ["get_model", "list_models", "register"]

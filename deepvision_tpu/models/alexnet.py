@@ -1,0 +1,103 @@
+"""AlexNet V1 and V2.
+
+- V1: the original 2012 net collapsed into a single tower with the paper's
+  per-tower channel counts doubled, LRN after conv1/conv2, overlapping
+  3x3/2 max-pools, dropout(0.5) on both hidden FC layers —
+  ref: AlexNet/pytorch/models/alexnet_v1.py:11-125.
+- V2: the "one weird trick" single-column variant (64/192/384/384/256), no
+  LRN — ref: AlexNet/pytorch/models/alexnet_v2.py:12-75. The TF twin pads
+  input to 227 and keeps an LRN Layer —
+  ref: AlexNet/tensorflow/models/alexnet_v2.py:9-70; its LRN is available
+  here via ``use_lrn=True``.
+
+Inputs are 224x224x3 (V1 uses VALID 11x11/4 conv ≈ the paper's 227 geometry).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepvision_tpu.models import layers
+from deepvision_tpu.models.registry import register
+from deepvision_tpu.ops.lrn import local_response_norm
+
+
+class AlexNetV1(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        conv = lambda f, k, s, p, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding=p, dtype=self.dtype, name=name
+        )
+        # conv1: 96 filters 11x11/4 + LRN + pool (channel counts are the
+        # doubled single-tower numbers, ref: alexnet_v1.py:13 note).
+        # Asymmetric (1,2) padding makes 224 behave as the paper's 227,
+        # giving the 6x6x256 flatten the 60M-param FC stack requires
+        # (the TF twin zero-pads to 227 — ref: alexnet_v2.py ZeroPadding).
+        x = nn.relu(conv(96, 11, 4, [(1, 2), (1, 2)], "conv1")(x))
+        x = local_response_norm(x)
+        x = layers.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(conv(256, 5, 1, "SAME", "conv2")(x))
+        x = local_response_norm(x)
+        x = layers.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(conv(384, 3, 1, "SAME", "conv3")(x))
+        x = nn.relu(conv(384, 3, 1, "SAME", "conv4")(x))
+        x = nn.relu(conv(256, 3, 1, "SAME", "conv5")(x))
+        x = layers.max_pool(x, (3, 3), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc6")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc7")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc8")(x)
+
+
+class AlexNetV2(nn.Module):
+    num_classes: int = 1000
+    use_lrn: bool = False  # TF variant keeps LRN (alexnet_v2.py:9-24)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        conv = lambda f, k, s, p, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding=p, dtype=self.dtype, name=name
+        )
+        x = nn.relu(conv(64, 11, 4, [(2, 2), (2, 2)], "conv1")(x))
+        if self.use_lrn:
+            x = local_response_norm(x)
+        x = layers.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(conv(192, 5, 1, "SAME", "conv2")(x))
+        if self.use_lrn:
+            x = local_response_norm(x)
+        x = layers.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(conv(384, 3, 1, "SAME", "conv3")(x))
+        x = nn.relu(conv(384, 3, 1, "SAME", "conv4")(x))
+        x = nn.relu(conv(256, 3, 1, "SAME", "conv5")(x))
+        x = layers.max_pool(x, (3, 3), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc6")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc7")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc8")(x)
+
+
+@register("alexnet1")
+def _alexnet_v1(**kw):
+    return AlexNetV1(**kw)
+
+
+@register("alexnet2")
+def _alexnet_v2(**kw):
+    return AlexNetV2(**kw)
+
+
+@register("alexnet2_tf")
+def _alexnet_v2_tf(**kw):
+    kw.setdefault("use_lrn", True)
+    return AlexNetV2(**kw)

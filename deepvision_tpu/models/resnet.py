@@ -1,0 +1,201 @@
+"""ResNet family — the framework's flagship (north-star config).
+
+V1 variants re-express the PyTorch reference:
+- ResNet-34: BasicBlock stacks (3,4,6,3) — ref: ResNet/pytorch/models/resnet34.py:8-143.
+- ResNet-50: BottleneckBlock 1x1-3x3-1x1 stacks (3,4,6,3) —
+  ref: ResNet/pytorch/models/resnet50.py:8-165.
+- ResNet-152: same with (3,8,36,3) — ref: ResNet/pytorch/models/resnet152.py:38-39.
+
+Init parity: he-normal convs, BN gamma=1 beta=0 (ref: resnet50.py:84-93).
+
+Reference quirk kept behind ``always_project`` (default True for checkpoint-
+converter parity): the first block of EVERY group gets a projection shortcut
+even when stride=1 and channels match (ResNet-34 group 1), adding params vs
+the paper — ref: resnet34.py:69-75. Set False for the paper-faithful net.
+
+ResNet-50 V2 is the pre-activation variant (BN-ReLU before conv, stem without
+BN, final BN-ReLU before GAP) — ref: ResNet/tensorflow/models/resnet50v2.py:18-171.
+The TF reference's in-model softmax (resnet50.py:42) is normalized away: all
+variants emit logits.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepvision_tpu.models import layers
+from deepvision_tpu.models.layers import ConvBN, he_normal
+from deepvision_tpu.models.registry import register
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/34)."""
+
+    features: int
+    strides: int = 1
+    project: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = ConvBN(self.features, (3, 3), (self.strides,) * 2,
+                   dtype=self.dtype, name="conv1")(x, train)
+        y = ConvBN(self.features, (3, 3), act=None,
+                   dtype=self.dtype, name="conv2")(y, train)
+        if self.project:
+            residual = ConvBN(self.features, (1, 1), (self.strides,) * 2,
+                              act=None, dtype=self.dtype, name="proj")(x, train)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce → 3x3 → 1x1 expand (×4), stride on the 3x3 (torchvision/
+    reference convention — ref: ResNet/pytorch/models/resnet50.py:24-47)."""
+
+    features: int  # bottleneck width; output is features * 4
+    strides: int = 1
+    project: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = ConvBN(self.features, (1, 1), dtype=self.dtype, name="conv1")(x, train)
+        y = ConvBN(self.features, (3, 3), (self.strides,) * 2,
+                   dtype=self.dtype, name="conv2")(y, train)
+        y = ConvBN(self.features * 4, (1, 1), act=None,
+                   dtype=self.dtype, name="conv3")(y, train)
+        if self.project:
+            residual = ConvBN(self.features * 4, (1, 1), (self.strides,) * 2,
+                              act=None, dtype=self.dtype, name="proj")(x, train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: type = BottleneckBlock
+    num_classes: int = 1000
+    num_filters: int = 64
+    always_project: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBN(self.num_filters, (7, 7), (2, 2),
+                   dtype=self.dtype, name="stem")(x, train)
+        x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            feats = self.num_filters * (2 ** i)
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                first = j == 0
+                project = first and (
+                    self.always_project
+                    or strides != 1
+                    or self.block is BottleneckBlock
+                )
+                x = self.block(
+                    feats, strides=strides, project=project,
+                    dtype=self.dtype, name=f"stage{i + 1}_block{j + 1}",
+                )(x, train)
+        x = layers.global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        kernel_init=he_normal, name="fc")(x)
+
+
+class PreActBottleneck(nn.Module):
+    """V2 pre-activation bottleneck (ref: resnet50v2.py block fns)."""
+
+    features: int
+    strides: int = 1
+    project: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        pre = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                           epsilon=1.001e-5, dtype=jnp.float32,
+                           name="preact_bn")(x)
+        pre = nn.relu(pre)
+        if self.project:
+            residual = nn.Conv(self.features * 4, (1, 1),
+                               strides=(self.strides,) * 2, use_bias=True,
+                               kernel_init=he_normal, dtype=self.dtype,
+                               name="proj")(pre)
+        elif self.strides > 1:
+            residual = layers.max_pool(x, (1, 1), (self.strides,) * 2)
+        else:
+            residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=he_normal, dtype=self.dtype, name="conv1")(pre)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1.001e-5, dtype=jnp.float32, name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                    padding="SAME", use_bias=False, kernel_init=he_normal,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1.001e-5, dtype=jnp.float32, name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=True,
+                    kernel_init=he_normal, dtype=self.dtype, name="conv3")(y)
+        return y + residual
+
+
+class ResNetV2(nn.Module):
+    """Pre-activation ResNet (keras-applications structure —
+    ref: ResNet/tensorflow/models/resnet50v2.py:18-171). Strides live on the
+    LAST block of each group except the final group, matching the reference's
+    ``stack2`` layout."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME", use_bias=True,
+                    kernel_init=he_normal, dtype=self.dtype, name="stem")(x)
+        x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        n_stages = len(self.stage_sizes)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            feats = 64 * (2 ** i)
+            for j in range(n_blocks):
+                last = j == n_blocks - 1
+                strides = 2 if (last and i < n_stages - 1) else 1
+                x = PreActBottleneck(
+                    feats, strides=strides, project=(j == 0),
+                    dtype=self.dtype, name=f"stage{i + 1}_block{j + 1}",
+                )(x, train)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1.001e-5, dtype=jnp.float32, name="post_bn")(x)
+        x = nn.relu(x)
+        x = layers.global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        kernel_init=he_normal, name="fc")(x)
+
+
+@register("resnet34")
+def _resnet34(**kw):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock, **kw)
+
+
+@register("resnet50")
+def _resnet50(**kw):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock, **kw)
+
+
+@register("resnet152")
+def _resnet152(**kw):
+    return ResNet(stage_sizes=(3, 8, 36, 3), block=BottleneckBlock, **kw)
+
+
+@register("resnet50v2")
+def _resnet50v2(**kw):
+    return ResNetV2(stage_sizes=(3, 4, 6, 3), **kw)

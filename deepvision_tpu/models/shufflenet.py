@@ -1,0 +1,90 @@
+"""ShuffleNet V1 — grouped 1x1 convs + channel shuffle.
+
+The reference never finished this: the model file is empty and the README
+says "This is still WIP" (ref: ShuffleNet/pytorch/models/shufflenet_v1.py
+[0 bytes], ShuffleNet/pytorch/README.md:1). Implemented here in full per the
+paper (g=3 column: 240/480/960 channels, stages of 4/8/4 blocks) — a
+CAPABILITY COMPLETION, flagged per SURVEY §2.1.
+
+Channel shuffle is a pure layout op (reshape-transpose-reshape) that XLA
+folds into the surrounding convs' layout assignments — free on TPU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepvision_tpu.models import layers
+from deepvision_tpu.models.layers import ConvBN
+from deepvision_tpu.models.registry import register
+
+_STAGE_CHANNELS = {1: 144, 2: 200, 3: 240, 4: 272, 8: 384}
+_STAGE_BLOCKS = (4, 8, 4)
+
+
+def channel_shuffle(x: jnp.ndarray, groups: int) -> jnp.ndarray:
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, groups, c // groups)
+    x = x.transpose(0, 1, 2, 4, 3)
+    return x.reshape(b, h, w, c)
+
+
+class ShuffleUnit(nn.Module):
+    features: int  # output channels of the unit
+    groups: int = 3
+    strides: int = 1
+    first_group: bool = True  # no groups on the 1x1 reduce of stage2 block1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        out = self.features - (x.shape[-1] if self.strides == 2 else 0)
+        # Bottleneck width is 1/4 of the unit's NOMINAL width (paper §3.2),
+        # not of the concat-adjusted `out` — keeps `mid` divisible by the
+        # group count for every paper column (g in {1,2,3,4,8}).
+        mid = self.features // 4
+        g1 = self.groups if self.first_group else 1
+        y = ConvBN(mid, (1, 1), groups=g1, dtype=d, name="gconv1")(x, train)
+        y = channel_shuffle(y, self.groups)
+        y = ConvBN(mid, (3, 3), (self.strides,) * 2, groups=mid, act=None,
+                   dtype=d, name="dwconv")(y, train)
+        y = ConvBN(out, (1, 1), groups=self.groups, act=None,
+                   dtype=d, name="gconv2")(y, train)
+        if self.strides == 2:
+            shortcut = layers.avg_pool(x, (3, 3), (2, 2), padding="SAME")
+            return nn.relu(jnp.concatenate([shortcut, y], axis=-1))
+        return nn.relu(x + y)
+
+
+class ShuffleNetV1(nn.Module):
+    num_classes: int = 1000
+    groups: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        x = x.astype(d)
+        x = ConvBN(24, (3, 3), (2, 2), dtype=d, name="stem")(x, train)
+        x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        base = _STAGE_CHANNELS[self.groups]
+        for stage, n_blocks in enumerate(_STAGE_BLOCKS):
+            feats = base * (2 ** stage)
+            for j in range(n_blocks):
+                x = ShuffleUnit(
+                    feats,
+                    groups=self.groups,
+                    strides=2 if j == 0 else 1,
+                    first_group=not (stage == 0 and j == 0),
+                    dtype=d,
+                    name=f"stage{stage + 2}_unit{j + 1}",
+                )(x, train)
+        x = layers.global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+
+
+@register("shufflenet1")
+def _shufflenet_v1(**kw):
+    return ShuffleNetV1(**kw)

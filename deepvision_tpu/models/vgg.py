@@ -1,0 +1,62 @@
+"""VGG-16 (config D) and VGG-19 (config E).
+
+ref: VGG/pytorch/models/vgg16.py:8-127 / vgg19.py. Xavier conv init +
+N(0, 0.01) linear init — the reference documents this choice as necessary
+for convergence (ref: vgg16.py:113-119) — reproduced here.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepvision_tpu.models import layers
+from deepvision_tpu.models.layers import xavier_uniform
+from deepvision_tpu.models.registry import register
+
+_CFG = {
+    "vgg16": (2, 2, 3, 3, 3),
+    "vgg19": (2, 2, 4, 4, 4),
+}
+_FILTERS = (64, 128, 256, 512, 512)
+
+normal_001 = nn.initializers.normal(stddev=0.01)
+
+
+class VGG(nn.Module):
+    stage_convs: Sequence[int]
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for i, (n, f) in enumerate(zip(self.stage_convs, _FILTERS)):
+            for j in range(n):
+                x = nn.relu(
+                    nn.Conv(f, (3, 3), padding="SAME",
+                            kernel_init=xavier_uniform, dtype=self.dtype,
+                            name=f"conv{i + 1}_{j + 1}")(x)
+                )
+            x = layers.max_pool(x)
+        x = x.reshape((x.shape[0], -1))  # 7*7*512
+        x = nn.relu(nn.Dense(4096, kernel_init=normal_001,
+                             dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, kernel_init=normal_001,
+                             dtype=self.dtype, name="fc2")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, kernel_init=normal_001,
+                        dtype=jnp.float32, name="fc3")(x)
+
+
+@register("vgg16")
+def _vgg16(**kw):
+    return VGG(stage_convs=_CFG["vgg16"], **kw)
+
+
+@register("vgg19")
+def _vgg19(**kw):
+    return VGG(stage_convs=_CFG["vgg19"], **kw)

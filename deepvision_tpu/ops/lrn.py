@@ -1,0 +1,41 @@
+"""Local Response Normalization (cross-channel), the AlexNet-era op.
+
+The PT reference uses ``nn.LocalResponseNorm`` (ref:
+AlexNet/pytorch/models/alexnet_v1.py LRN layers); the TF reference hand-rolls
+a Keras layer over ``tf.nn.local_response_normalization`` (ref:
+AlexNet/tensorflow/models/alexnet_v2.py:9-24). JAX has no built-in, so this
+is written as a windowed reduction over the channel axis — XLA fuses the
+square/add/pow chain into one elementwise kernel around the reduce-window,
+which is the right TPU lowering for this (rare, bandwidth-bound) op.
+
+Semantics match torch: ``b_c = a_c / (k + (alpha/n) * sum_{c'} a_{c'}^2)^beta``
+with the sum over a window of ``n`` channels centered at ``c``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def local_response_norm(
+    x: jax.Array,
+    size: int = 5,
+    alpha: float = 1e-4,
+    beta: float = 0.75,
+    k: float = 2.0,
+) -> jax.Array:
+    """NHWC input; normalizes over the trailing channel axis."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    sq = x32 * x32
+    half = size // 2
+    window = [1] * (x.ndim - 1) + [size]
+    sums = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        window_dimensions=window,
+        window_strides=[1] * x.ndim,
+        padding=[(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)],
+    )
+    denom = jnp.power(k + (alpha / size) * sums, beta)
+    return (x32 / denom).astype(dtype)

@@ -50,11 +50,19 @@ def create_train_state(
     rng: jax.Array | int = 0,
     train_kwarg: bool = True,
 ) -> TrainState:
-    """Initialize params/batch_stats from a sample batch and wrap with ``tx``."""
+    """Initialize params/batch_stats from a sample batch and wrap with ``tx``.
+
+    Initialization runs in TRAIN mode so lazily-created training-only
+    submodules (Inception aux classifiers — ref:
+    Inception/pytorch/models/inception_v1.py:92-113) get parameters.
+    """
     if isinstance(rng, int):
         rng = jax.random.key(rng)
-    kwargs = {"train": False} if train_kwarg else {}
-    variables = model.init(rng, sample_input, **kwargs)
+    p_rng, d_rng = jax.random.split(rng)
+    kwargs = {"train": True} if train_kwarg else {}
+    variables = model.init(
+        {"params": p_rng, "dropout": d_rng}, sample_input, **kwargs
+    )
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     return TrainState(

@@ -1,0 +1,107 @@
+"""Forward-shape + parameter-count units for the classification zoo.
+
+The reference documents param counts in model summaries (e.g. MobileNet
+"Trainable params: 4,242,856" — ref: MobileNet/tensorflow/train.py:35);
+well-known torchvision counts bound the rest. Counts here are over the
+``params`` collection (BN scale/bias included, running stats excluded —
+same notion as Keras "trainable params").
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deepvision_tpu.models import get_model
+
+# name, input hw, expected (lo, hi) param count, n outputs in train mode
+CASES = [
+    ("alexnet1", 224, (58e6, 65e6), 1),
+    ("alexnet2", 224, (58e6, 64e6), 1),
+    ("vgg16", 224, (138e6, 139e6), 1),
+    ("vgg19", 224, (143e6, 144e6), 1),
+    ("inception1", 224, (11e6, 14e6), 3),
+    ("resnet34", 224, (21.7e6, 22.0e6), 1),
+    ("resnet50", 224, (25.4e6, 25.7e6), 1),
+    ("resnet50v2", 224, (25.4e6, 25.7e6), 1),
+    ("mobilenet1", 224, (4.0e6, 4.4e6), 1),
+    ("shufflenet1", 224, (1.3e6, 2.5e6), 1),
+]
+
+HEAVY_CASES = [
+    ("resnet152", 224, (60.0e6, 60.4e6), 1),
+    ("inception3", 299, (23e6, 28e6), 2),
+]
+
+
+def _check(name, hw, bounds, n_out):
+    model = get_model(name)
+    x = np.zeros((2, hw, hw, 3), np.float32)
+    variables = jax.eval_shape(
+        lambda k: model.init({"params": k, "dropout": k}, x, train=True),
+        jax.random.key(0),
+    )
+    n_params = sum(
+        int(np.prod(s.shape))
+        for s in jax.tree_util.tree_leaves(variables["params"])
+    )
+    lo, hi = bounds
+    assert lo <= n_params <= hi, f"{name}: {n_params:,} params not in [{lo:,.0f}, {hi:,.0f}]"
+    # eval-mode forward shape (abstract — no FLOPs burned)
+    out = jax.eval_shape(
+        lambda v: model.apply(
+            {k: v[k] for k in ("params", "batch_stats") if k in v},
+            x, train=False),
+        variables,
+    )
+    assert out.shape == (2, 1000), f"{name}: {out.shape}"
+    # train-mode output arity
+    out_t = jax.eval_shape(
+        lambda v, k: model.apply(
+            {kk: v[kk] for kk in ("params", "batch_stats") if kk in v},
+            x, train=True, mutable=["batch_stats"], rngs={"dropout": k}),
+        variables, jax.random.key(1),
+    )[0]
+    arity = len(out_t) if isinstance(out_t, (tuple, list)) else 1
+    assert arity == n_out, f"{name}: train-mode arity {arity} != {n_out}"
+
+
+@pytest.mark.parametrize("name,hw,bounds,n_out", CASES)
+def test_model_params_and_shapes(name, hw, bounds, n_out):
+    _check(name, hw, bounds, n_out)
+
+
+@pytest.mark.parametrize("name,hw,bounds,n_out", HEAVY_CASES)
+def test_heavy_model_params_and_shapes(name, hw, bounds, n_out):
+    _check(name, hw, bounds, n_out)
+
+
+def test_lrn_matches_torch_semantics():
+    """LRN vs an independent numpy implementation of the torch formula."""
+    from deepvision_tpu.ops.lrn import local_response_norm
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 4, 4, 7)).astype(np.float32)
+    size, alpha, beta, k = 5, 1e-4, 0.75, 2.0
+    out = np.asarray(local_response_norm(x, size, alpha, beta, k))
+    # reference computation
+    sq = x**2
+    C = x.shape[-1]
+    half = size // 2
+    expect = np.empty_like(x)
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + size - half)
+        s = sq[..., lo:hi].sum(-1)
+        expect[..., c] = x[..., c] / (k + (alpha / size) * s) ** beta
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_channel_shuffle_roundtrip():
+    from deepvision_tpu.models.shufflenet import channel_shuffle
+
+    x = np.arange(2 * 1 * 1 * 12, dtype=np.float32).reshape(2, 1, 1, 12)
+    y = np.asarray(channel_shuffle(x, 3))
+    # shuffle with g groups then with c//g groups is identity
+    z = np.asarray(channel_shuffle(y, 4))
+    np.testing.assert_array_equal(x, z)
+    # channels are interleaved, not identical
+    assert not np.array_equal(x, y)
