@@ -1,0 +1,13 @@
+"""Offline TFRecord builders for the reference's dataset zoo.
+
+Replaces the reference's three generations of builder tooling — TF1
+Session-based threading (ImageNet, ref:
+Datasets/ILSVRC2012/build_imagenet_tfrecord.py), Ray remote shard writers
+(VOC/COCO/MPII, ref: Datasets/VOC2007/tfrecords.py:98-121) — with one
+``multiprocessing`` shard-writer over the dependency-free codec in
+data/tfrecord.py.
+"""
+
+from deepvision_tpu.data.builders.shard_writer import write_sharded
+
+__all__ = ["write_sharded"]

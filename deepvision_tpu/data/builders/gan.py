@@ -1,0 +1,79 @@
+"""CycleGAN unpaired-image records + CelebA attribute splitter.
+
+- builder: trainA/trainB/testA/testB image-only records
+  (ref: CycleGAN/tensorflow/tfrecords.py:9-73),
+- splitter: img_align_celeba -> trainA/trainB by a named attribute column
+  (gender in the reference — ref: CycleGAN/tensorflow/celeba.py:1-24),
+  generalized to any attribute in the standard list_attr_celeba.txt.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+
+from deepvision_tpu.data.builders.shard_writer import write_sharded
+from deepvision_tpu.data.image_io import ensure_rgb_jpeg
+
+
+def _image_features(path: Path) -> dict | None:
+    try:
+        data, width, height = ensure_rgb_jpeg(path.read_bytes())
+    except Exception:
+        return None
+    return {
+        "image/encoded": [data],
+        "image/height": [height],
+        "image/width": [width],
+        "image/filename": [path.name.encode()],
+    }
+
+
+def build_cyclegan_tfrecords(
+    data_root: str | Path, output_dir: str | Path,
+    *, num_shards: int = 4, num_workers: int = 4,
+) -> dict[str, int]:
+    """data_root contains trainA/trainB/testA/testB image dirs."""
+    counts = {}
+    for split in ("trainA", "trainB", "testA", "testB"):
+        d = Path(data_root) / split
+        if not d.is_dir():
+            continue
+        files = sorted(p for p in d.iterdir()
+                       if p.suffix.lower() in (".jpg", ".jpeg", ".png"))
+        counts[split] = write_sharded(
+            files, _image_features, output_dir, split,
+            num_shards=num_shards, num_workers=num_workers,
+        )
+    return counts
+
+
+def split_celeba_by_attribute(
+    celeba_dir: str | Path, attr_file: str | Path, output_root: str | Path,
+    *, attribute: str = "Male", limit_per_side: int | None = None,
+) -> tuple[int, int]:
+    """img_align_celeba + list_attr_celeba.txt -> trainA (attr=-1) /
+    trainB (attr=+1) file trees (ref: celeba.py:1-24)."""
+    lines = Path(attr_file).read_text().splitlines()
+    header = lines[1].split()
+    col = header.index(attribute)
+    out_a = Path(output_root) / "trainA"
+    out_b = Path(output_root) / "trainB"
+    out_a.mkdir(parents=True, exist_ok=True)
+    out_b.mkdir(parents=True, exist_ok=True)
+    n_a = n_b = 0
+    for line in lines[2:]:
+        parts = line.split()
+        if not parts:
+            continue
+        name, value = parts[0], int(parts[1 + col])
+        src = Path(celeba_dir) / name
+        if not src.exists():
+            continue
+        if value < 0 and (limit_per_side is None or n_a < limit_per_side):
+            shutil.copy(src, out_a / name)
+            n_a += 1
+        elif value > 0 and (limit_per_side is None or n_b < limit_per_side):
+            shutil.copy(src, out_b / name)
+            n_b += 1
+    return n_a, n_b

@@ -1,0 +1,102 @@
+"""ILSVRC2012 TFRecord builder.
+
+Rebuilds ref: Datasets/ILSVRC2012/build_imagenet_tfrecord.py (710 LoC of
+TF1 Session threading) as a multiprocessing tool over the pure codec:
+
+- input: the flattened layout the reference's shell prep produces
+  (``<synset>_<name>.JPEG`` in one dir — ref: DATASET.md:73-118),
+- schema parity with ``_convert_to_example`` (ref: :216-231): image/encoded,
+  height/width, colorspace/channels/format, class/label (1-based!)/synset/
+  text, optional bbox lists, filename,
+- image repair: PNG-disguised-as-JPEG and CMYK files are detected and
+  re-encoded via PIL (replacing the ``ImageCoder`` TF-session pipeline and
+  its hardcoded dirty-file blacklists — ref: :235-308; detection here is by
+  content, so no blacklist maintenance),
+- default shard counts 1024/128 (ref: :111-114).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from deepvision_tpu.data.builders.shard_writer import write_sharded
+from deepvision_tpu.data.folder import load_synset_maps
+from deepvision_tpu.data.image_io import ensure_rgb_jpeg
+
+
+def _make_features_fn(wnid_to_idx, human_map, bboxes):
+    def make_features(path: Path):
+        try:
+            data, width, height = ensure_rgb_jpeg(path.read_bytes())
+        except Exception:
+            return None  # dirty-image skip
+        synset = path.name.split("_")[0]
+        label = wnid_to_idx[synset] + 1  # 1-based (ref: :216-231 schema)
+        feats = {
+            "image/encoded": [data],
+            "image/height": [height],
+            "image/width": [width],
+            "image/colorspace": [b"RGB"],
+            "image/channels": [3],
+            "image/format": [b"JPEG"],
+            "image/class/label": [label],
+            "image/class/synset": [synset.encode()],
+            "image/class/text": [human_map.get(synset, "").encode()],
+            "image/filename": [path.name.encode()],
+        }
+        boxes = bboxes.get(path.name, [])
+        if boxes:
+            for i, key in enumerate(("xmin", "ymin", "xmax", "ymax")):
+                feats[f"image/object/bbox/{key}"] = [
+                    float(b[i]) for b in boxes
+                ]
+            feats["image/object/bbox/label"] = [label] * len(boxes)
+        return feats
+
+    return make_features
+
+
+def load_bbox_csv(csv_path: str | Path) -> dict[str, list]:
+    """CSV from the bbox XML converter: filename,xmin,ymin,xmax,ymax
+    normalized to [0,1] (ref: process_bounding_boxes.py:16-60)."""
+    out: dict[str, list] = {}
+    p = Path(csv_path)
+    if not p.exists():
+        return out
+    for line in p.read_text().splitlines():
+        parts = line.strip().split(",")
+        if len(parts) != 5:
+            continue
+        name, *coords = parts
+        out.setdefault(name, []).append([float(c) for c in coords])
+    return out
+
+
+def build_imagenet_tfrecords(
+    image_dir: str | Path,
+    synsets_file: str | Path,
+    output_dir: str | Path,
+    split: str = "train",
+    *,
+    human_labels_file: str | Path | None = None,
+    bbox_csv: str | Path | None = None,
+    num_shards: int | None = None,
+    num_workers: int = 16,
+) -> int:
+    wnid_to_idx, _ = load_synset_maps(synsets_file)
+    human_map = {}
+    if human_labels_file and Path(human_labels_file).exists():
+        for line in Path(human_labels_file).read_text().splitlines():
+            if "\t" in line:
+                wnid, text = line.split("\t", 1)
+                human_map[wnid] = text.strip()
+    bboxes = load_bbox_csv(bbox_csv) if bbox_csv else {}
+    if num_shards is None:
+        num_shards = 1024 if split == "train" else 128  # ref: :111-114
+    files = sorted(Path(image_dir).glob("*.JPEG"))
+    return write_sharded(
+        files,
+        _make_features_fn(wnid_to_idx, human_map, bboxes),
+        output_dir, split,
+        num_shards=num_shards, num_workers=num_workers,
+    )

@@ -1,0 +1,110 @@
+"""Flattened-folder ImageNet dataset + parallel host loader.
+
+Mirrors ``ImageNet2012Dataset`` (ref: ResNet/pytorch/data_load.py:14-69):
+a flattened directory of ``<synset>_<name>.JPEG`` files, label↔index maps
+built from ``synsets.txt``, cv2 JPEG decode + transform per sample. The
+reference parallelizes with ``DataLoader(num_workers=16)`` forked workers
+(ref: ResNet/pytorch/train.py:229-234); here a ``multiprocessing.Pool``
+maps the decode+augment over each batch's files with per-sample seeded RNG
+(deterministic under any worker count — the reference's loader was not).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from pathlib import Path
+
+import numpy as np
+
+from deepvision_tpu.data import transforms as T
+
+
+def load_synset_maps(synsets_file: str | Path):
+    """synsets.txt (one WNID per line, index order) -> (wnid->idx, idx->wnid)."""
+    wnids = [l.strip() for l in Path(synsets_file).read_text().splitlines()
+             if l.strip()]
+    return {w: i for i, w in enumerate(wnids)}, wnids
+
+
+class ImageNetFolderDataset:
+    def __init__(self, image_dir: str | Path, synsets_file: str | Path,
+                 transform: T.Compose, *, seed: int = 0):
+        self.image_dir = Path(image_dir)
+        self.wnid_to_idx, self.wnids = load_synset_maps(synsets_file)
+        self.transform = transform
+        self.seed = seed
+        # filename 'n01440764_10026.JPEG' -> synset prefix
+        # (ref: data_load.py:49-69)
+        self.files = sorted(self.image_dir.glob("*.JPEG"))
+        self.labels = np.array(
+            [self.wnid_to_idx[f.name.split("_")[0]] for f in self.files],
+            np.int32,
+        )
+
+    def __len__(self):
+        return len(self.files)
+
+    def load(self, i: int, epoch: int = 0) -> tuple[np.ndarray, int]:
+        import cv2
+
+        img = cv2.imread(str(self.files[i]))  # BGR
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        rng = np.random.default_rng(
+            (self.seed * 1_000_003 + epoch) * 1_000_003 + i
+        )
+        return self.transform(rng, img), int(self.labels[i])
+
+
+# Worker-process dataset handle: shipped ONCE via the pool initializer
+# instead of pickling the (potentially 1.28M-file) dataset per sample.
+_WORKER_DS: ImageNetFolderDataset | None = None
+
+
+def _init_worker(ds: ImageNetFolderDataset):
+    global _WORKER_DS
+    _WORKER_DS = ds
+
+
+def _load_one(args):
+    i, epoch = args
+    return _WORKER_DS.load(i, epoch)
+
+
+class FolderLoader:
+    """Batched parallel loader over an ImageNetFolderDataset."""
+
+    def __init__(self, dataset: ImageNetFolderDataset, batch_size: int,
+                 *, shuffle: bool = True, num_workers: int = 8,
+                 drop_remainder: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.num_workers = num_workers
+        self.drop_remainder = drop_remainder
+
+    def epoch(self, epoch: int = 0):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.default_rng(epoch).shuffle(order)
+        end = n - n % self.batch_size if self.drop_remainder else n
+        pool = (
+            mp.Pool(self.num_workers, initializer=_init_worker,
+                    initargs=(self.dataset,))
+            if self.num_workers > 1 else None
+        )
+        try:
+            for s in range(0, end, self.batch_size):
+                idx = order[s : s + self.batch_size]
+                work = [(int(i), epoch) for i in idx]
+                if pool is not None:
+                    samples = pool.map(_load_one, work)
+                else:
+                    samples = [self.dataset.load(i, e) for i, e in work]
+                images = np.stack([im for im, _ in samples])
+                labels = np.array([lb for _, lb in samples], np.int32)
+                yield {"image": images, "label": labels}
+        finally:
+            if pool is not None:
+                pool.close()
+                pool.join()

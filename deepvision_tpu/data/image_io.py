@@ -1,0 +1,26 @@
+"""Shared image normalization for the dataset builders.
+
+One implementation of the reference's ``ImageCoder`` repair behavior
+(PNG-disguised-as-JPEG and CMYK files re-encoded —
+ref: Datasets/ILSVRC2012/build_imagenet_tfrecord.py:235-269, and the COCO
+re-encode — ref: Datasets/MSCOCO/tfrecords.py:42-47), detection by content
+instead of the reference's hardcoded filename blacklists (:272-308).
+"""
+
+from __future__ import annotations
+
+import io
+
+
+def ensure_rgb_jpeg(data: bytes) -> tuple[bytes, int, int]:
+    """-> (valid RGB JPEG bytes, width, height). Raises on undecodable input
+    (callers treat that as the dirty-image skip)."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data))
+    width, height = img.size
+    if data[:2] == b"\xff\xd8" and img.format == "JPEG" and img.mode == "RGB":
+        return data, width, height
+    buf = io.BytesIO()
+    img.convert("RGB").save(buf, "JPEG", quality=95)
+    return buf.getvalue(), width, height

@@ -1,0 +1,145 @@
+"""ImageNet input pipeline: sharded TFRecords → device-ready NHWC batches.
+
+Host side is ``tf.data`` (the only engine that can feed a TPU pod from
+Python at line rate — SURVEY §7 hard part #1), with preprocessing parity to
+the reference's "ResNet preprocessing"
+(ref: ResNet/tensorflow/data_load.py:35-193):
+
+  train: decode → aspect-preserving resize (shorter side 256) → random
+         224 crop → random horizontal flip → channel-mean subtraction
+         (123.68/116.78/103.94 — ref: data_load.py:35-38)
+  eval:  decode → aspect-preserving resize → central crop → mean subtract
+
+Record schema is the reference builder's
+(ref: Datasets/ILSVRC2012/build_imagenet_tfrecord.py:216-231):
+``image/encoded`` JPEG bytes, ``image/class/label`` in [1, 1000]
+(shifted to [0, 999] here), plus filename/synset/bbox side fields.
+
+The pipeline yields host numpy batches; core.shard_batch places them on the
+mesh (per-host sharding for multi-host comes from ``shard_by_process``).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+CHANNEL_MEANS = (123.68, 116.78, 103.94)  # ref: data_load.py:35-38
+RESIZE_MIN = 256
+
+
+def resize_min_for(size: int) -> int:
+    """Shorter-side resize target for a given crop: the reference's 256 for
+    224 crops (ref: data_load.py), generalized by the standard 0.875
+    crop-fraction rule so larger crops (Inception V3's 299 -> 342) work."""
+    return max(RESIZE_MIN, round(size / 0.875))
+
+
+def _tf():
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    return tf
+
+
+def parse_and_preprocess(serialized, size: int, is_training: bool):
+    """One Example -> (f32 image [size,size,3] mean-subtracted, int32 label)."""
+    tf = _tf()
+    feats = tf.io.parse_single_example(
+        serialized,
+        {
+            "image/encoded": tf.io.FixedLenFeature([], tf.string),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+        },
+    )
+    image = tf.io.decode_jpeg(feats["image/encoded"], channels=3)
+    image = tf.cast(image, tf.float32)
+
+    # aspect-preserving resize: shorter side -> resize_min_for(size)
+    # (ref: data_load.py _aspect_preserving_resize)
+    shape = tf.shape(image)
+    h, w = tf.cast(shape[0], tf.float32), tf.cast(shape[1], tf.float32)
+    scale = resize_min_for(size) / tf.minimum(h, w)
+    new_h = tf.cast(tf.math.ceil(h * scale), tf.int32)
+    new_w = tf.cast(tf.math.ceil(w * scale), tf.int32)
+    image = tf.image.resize(image, [new_h, new_w])
+
+    if is_training:
+        image = tf.image.random_crop(image, [size, size, 3])
+        image = tf.image.random_flip_left_right(image)
+    else:
+        # central crop (ref: data_load.py _central_crop)
+        off_h = (new_h - size) // 2
+        off_w = (new_w - size) // 2
+        image = tf.slice(image, [off_h, off_w, 0], [size, size, 3])
+    image = image - tf.constant(CHANNEL_MEANS, tf.float32)
+
+    label = tf.cast(feats["image/class/label"], tf.int32) - 1
+    return image, label
+
+
+def make_dataset(
+    file_pattern: str,
+    batch_size: int,
+    size: int = 224,
+    *,
+    is_training: bool,
+    shuffle_buffer: int = 10_000,
+    num_process: int = 1,
+    process_index: int = 0,
+):
+    """tf.data pipeline over sharded TFRecords; per-host file sharding for
+    multi-host (the ``experimental_distribute_dataset`` analog —
+    ref: YOLO/tensorflow/train.py:291-294)."""
+    tf = _tf()
+    files = tf.data.Dataset.list_files(file_pattern, shuffle=is_training,
+                                       seed=0)
+    if num_process > 1:
+        files = files.shard(num_process, process_index)
+    ds = tf.data.TFRecordDataset(
+        files, num_parallel_reads=tf.data.AUTOTUNE
+    )
+    if is_training:
+        ds = ds.shuffle(shuffle_buffer).repeat()
+    ds = ds.map(
+        lambda s: parse_and_preprocess(s, size, is_training),
+        num_parallel_calls=tf.data.AUTOTUNE,
+    )
+    ds = ds.batch(batch_size, drop_remainder=is_training)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+    return ds
+
+
+def _as_batches(ds, limit: int | None = None):
+    for i, (img, lbl) in enumerate(ds.as_numpy_iterator()):
+        if limit is not None and i >= limit:
+            return
+        yield {"image": img, "label": lbl}
+
+
+def make_imagenet_data(
+    data_dir: str, batch_size: int, size: int = 224,
+    *, train_images: int = 1_281_167, val_images: int = 50_000,
+):
+    """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
+
+    Shard-name layout follows the reference builder: 1024 train / 128 val
+    shards named ``train-*-of-*`` / ``validation-*-of-*``
+    (ref: build_imagenet_tfrecord.py:111-114).
+    """
+    d = Path(data_dir)
+    steps = train_images // batch_size
+    val_steps = val_images // batch_size
+
+    def train_data(epoch: int):
+        ds = make_dataset(str(d / "train-*"), batch_size, size,
+                          is_training=True)
+        return _as_batches(ds, steps)
+
+    def val_data():
+        ds = make_dataset(str(d / "validation-*"), batch_size, size,
+                          is_training=False)
+        return _as_batches(ds, val_steps)
+
+    return train_data, val_data, steps

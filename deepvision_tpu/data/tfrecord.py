@@ -1,0 +1,261 @@
+"""Dependency-free TFRecord + tf.train.Example codec.
+
+The reference's entire dataset layer is TFRecord-based (ImageNet builder —
+ref: Datasets/ILSVRC2012/build_imagenet_tfrecord.py:216-231; VOC/COCO/MPII —
+ref: Datasets/VOC2007/tfrecords.py:70-95). The training hot path reads these
+through ``tf.data`` (data/imagenet.py), but the framework also carries this
+pure-Python codec so that builders, tests, and tools work without TensorFlow
+and so the on-disk format is a documented contract rather than an opaque
+dependency.
+
+Formats implemented from their public specs:
+- TFRecord framing: ``<u64 len><u32 masked-crc32c(len)><bytes><u32
+  masked-crc32c(bytes)>`` with the masked Castagnoli CRC.
+- ``tf.train.Example`` protobuf wire format (varint/length-delimited
+  fields only; FloatList/Int64List accept both packed and unpacked).
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Iterator
+
+# --------------------------------------------------------------------------
+# CRC32C (Castagnoli), table-driven
+# --------------------------------------------------------------------------
+
+try:  # fast C path (bundled with TF distributions); pure-python fallback
+    import google_crc32c as _gcrc
+except ImportError:  # pragma: no cover
+    _gcrc = None
+
+_CRC_TABLES = None
+
+
+def _crc_tables():
+    """Slicing-by-8 tables (8x256) for the pure-python fallback."""
+    global _CRC_TABLES
+    if _CRC_TABLES is None:
+        poly = 0x82F63B78
+        base = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            base.append(c)
+        tables = [base]
+        for k in range(1, 8):
+            prev = tables[k - 1]
+            tables.append([base[prev[n] & 0xFF] ^ (prev[n] >> 8)
+                           for n in range(256)])
+        _CRC_TABLES = tables
+    return _CRC_TABLES
+
+
+def crc32c(data: bytes) -> int:
+    if _gcrc is not None:
+        return _gcrc.value(data)
+    t = _crc_tables()
+    crc = 0xFFFFFFFF
+    mv = memoryview(data)
+    n8 = len(mv) - len(mv) % 8
+    for i in range(0, n8, 8):
+        b0, b1, b2, b3, b4, b5, b6, b7 = mv[i : i + 8]
+        crc ^= b0 | b1 << 8 | b2 << 16 | b3 << 24
+        crc = (t[7][crc & 0xFF] ^ t[6][(crc >> 8) & 0xFF]
+               ^ t[5][(crc >> 16) & 0xFF] ^ t[4][crc >> 24]
+               ^ t[3][b4] ^ t[2][b5] ^ t[1][b6] ^ t[0][b7])
+    for b in mv[n8:]:
+        crc = t[0][(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --------------------------------------------------------------------------
+# TFRecord framing
+# --------------------------------------------------------------------------
+
+
+def write_records(path: str | Path, records: list[bytes]) -> None:
+    with open(path, "wb") as f:
+        for rec in records:
+            header = struct.pack("<Q", len(rec))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(rec)))
+
+
+def read_records(path: str | Path, *, verify: bool = True) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return
+            if len(header) < 8:
+                raise IOError(f"{path}: truncated length header")
+            (length,) = struct.unpack("<Q", header)
+            (len_crc,) = struct.unpack("<I", f.read(4))
+            data = f.read(length)
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if verify:
+                if _masked_crc(header) != len_crc:
+                    raise IOError(f"{path}: length CRC mismatch")
+                if _masked_crc(data) != data_crc:
+                    raise IOError(f"{path}: data CRC mismatch")
+            yield data
+
+
+# --------------------------------------------------------------------------
+# Minimal protobuf wire codec for tf.train.Example
+# --------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _field(num: int, wire: int) -> bytes:
+    return _varint(num << 3 | wire)
+
+
+def _ld(num: int, payload: bytes) -> bytes:  # length-delimited field
+    return _field(num, 2) + _varint(len(payload)) + payload
+
+
+def _encode_feature(value) -> bytes:
+    """value: list of bytes/str -> BytesList; float -> FloatList;
+    int -> Int64List."""
+    if not isinstance(value, (list, tuple)):
+        value = [value]
+    if not value:
+        return _ld(3, b"")  # empty Int64List
+    first = value[0]
+    if isinstance(first, (bytes, str)):
+        items = b"".join(
+            _ld(1, v.encode() if isinstance(v, str) else v) for v in value
+        )
+        return _ld(1, items)  # BytesList at field 1
+    if isinstance(first, float):
+        packed = struct.pack(f"<{len(value)}f", *value)
+        return _ld(2, _ld(1, packed))  # FloatList(packed) at field 2
+    if isinstance(first, (int, bool)):
+        packed = b"".join(
+            _varint(v & 0xFFFFFFFFFFFFFFFF) for v in value
+        )
+        return _ld(3, _ld(1, packed))  # Int64List(packed) at field 3
+    raise TypeError(f"unsupported feature value type {type(first)}")
+
+
+def encode_example(features: dict) -> bytes:
+    """dict -> serialized tf.train.Example bytes."""
+    entries = b""
+    for key in sorted(features):
+        feat = _encode_feature(features[key])
+        entry = _ld(1, key.encode()) + _ld(2, feat)
+        entries += _ld(1, entry)  # map entry, Features.feature field 1
+    return _ld(1, entries)  # Example.features field 1
+
+
+def _iter_fields(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        num, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos : pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos : pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos : pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield num, wire, val
+
+
+def _decode_feature(buf: bytes):
+    for num, _, val in _iter_fields(buf):
+        if num == 1:  # BytesList
+            return [v for n, _, v in _iter_fields(val) if n == 1]
+        if num == 2:  # FloatList — packed or repeated
+            floats = []
+            for n, wire, v in _iter_fields(val):
+                if n != 1:
+                    continue
+                if wire == 2:
+                    floats.extend(
+                        struct.unpack(f"<{len(v) // 4}f", v)
+                    )
+                else:  # wire 5: single fixed32
+                    floats.append(struct.unpack("<f", v)[0])
+            return floats
+        if num == 3:  # Int64List — packed or repeated varints
+            ints = []
+            for n, wire, v in _iter_fields(val):
+                if n != 1:
+                    continue
+                if wire == 2:
+                    p = 0
+                    while p < len(v):
+                        x, p = _read_varint(v, p)
+                        if x >= 1 << 63:
+                            x -= 1 << 64
+                        ints.append(x)
+                else:
+                    x = v if isinstance(v, int) else 0
+                    if x >= 1 << 63:
+                        x -= 1 << 64
+                    ints.append(x)
+            return ints
+    return []
+
+
+def decode_example(data: bytes) -> dict:
+    """serialized tf.train.Example -> {key: list of values}."""
+    out = {}
+    for num, _, features_buf in _iter_fields(data):
+        if num != 1:
+            continue
+        for n2, _, entry in _iter_fields(features_buf):
+            if n2 != 1:
+                continue
+            key = None
+            feat = b""
+            for n3, _, v in _iter_fields(entry):
+                if n3 == 1:
+                    key = v.decode()
+                elif n3 == 2:
+                    feat = v
+            if key is not None:
+                out[key] = _decode_feature(feat)
+    return out

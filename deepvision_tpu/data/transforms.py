@@ -1,0 +1,166 @@
+"""Numpy augmentation library — parity with the reference's PT transforms.
+
+The reference defines sample-dict transforms (Rescale/RandomCrop/CenterCrop/
+RandomHorizontalFlip/ToTensor/Normalize/ColorJitter —
+ref: ResNet/pytorch/data_load.py:72-296). Here they are pure numpy callables
+``(rng, image) -> image`` over HWC uint8/f32 arrays, composable with
+``Compose``; used by the folder-dataset path (data/folder.py) and by
+converter-parity tests. The hot TPU path uses the tf.data twin
+(data/imagenet.py) — these exist for semantic parity checking and CPU-side
+tooling, not for feeding pods.
+
+Divergence note (documented, ref parity kept where it matters): the PT
+ColorJitter does a PIL round-trip (ref: data_load.py:278-296); here the
+equivalent brightness/contrast/saturation jitters are computed directly in
+float, which matches PIL's enhance semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover
+    cv2 = None
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, rng: np.random.Generator, image: np.ndarray):
+        for t in self.transforms:
+            image = t(rng, image)
+        return image
+
+
+class Rescale:
+    """Aspect-preserving resize of the SHORTER side to ``size``
+    (ref: data_load.py Rescale)."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, rng, image):
+        h, w = image.shape[:2]
+        scale = self.size / min(h, w)
+        new_h, new_w = int(round(h * scale)), int(round(w * scale))
+        if cv2 is not None:
+            return cv2.resize(image, (new_w, new_h),
+                              interpolation=cv2.INTER_LINEAR)
+        # nearest-neighbor numpy fallback
+        ys = (np.arange(new_h) * h / new_h).astype(int)
+        xs = (np.arange(new_w) * w / new_w).astype(int)
+        return image[ys][:, xs]
+
+
+class RandomCrop:
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, rng, image):
+        h, w = image.shape[:2]
+        top = int(rng.integers(0, h - self.size + 1))
+        left = int(rng.integers(0, w - self.size + 1))
+        return image[top : top + self.size, left : left + self.size]
+
+
+class CenterCrop:
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, rng, image):
+        h, w = image.shape[:2]
+        top, left = (h - self.size) // 2, (w - self.size) // 2
+        return image[top : top + self.size, left : left + self.size]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, rng, image):
+        if rng.random() < self.p:
+            return image[:, ::-1]
+        return image
+
+
+class ToFloat:
+    """uint8 HWC -> float32 [0,1]; grayscale -> 3 channels
+    (ref: data_load.py ToTensor :183-189 minus the CHW transpose — the
+    framework is NHWC)."""
+
+    def __call__(self, rng, image):
+        if image.ndim == 2:
+            image = np.stack([image] * 3, axis=-1)
+        elif image.shape[-1] == 1:
+            image = np.repeat(image, 3, axis=-1)
+        return image.astype(np.float32) / 255.0
+
+
+class Normalize:
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, rng, image):
+        return (image - self.mean) / self.std
+
+
+class ColorJitter:
+    """brightness/contrast/saturation jitter with PIL-enhance semantics
+    (factor sampled in [max(0, 1-x), 1+x])."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    @staticmethod
+    def _factor(rng, amount):
+        return float(rng.uniform(max(0.0, 1 - amount), 1 + amount))
+
+    def __call__(self, rng, image):
+        img = image.astype(np.float32)
+        if self.brightness:
+            img = img * self._factor(rng, self.brightness)
+        if self.contrast:
+            f = self._factor(rng, self.contrast)
+            # PIL Contrast: blend with the mean of the grayscale image
+            gray = img @ np.array([0.299, 0.587, 0.114], np.float32)
+            img = gray.mean() * (1 - f) + img * f
+        if self.saturation:
+            f = self._factor(rng, self.saturation)
+            gray = (img @ np.array([0.299, 0.587, 0.114], np.float32))[..., None]
+            img = gray * (1 - f) + img * f
+        if image.dtype == np.uint8:
+            return np.clip(img, 0, 255).astype(np.uint8)
+        return img
+
+
+# Standard train/eval pipelines matching the ref's Compose stacks
+# (ref: ResNet/pytorch/train.py:315-331). The resize floor scales with the
+# crop (0.875 rule) so >256 crops (Inception V3) work.
+def _resize_min(size: int) -> int:
+    return max(256, round(size / 0.875))
+
+
+def imagenet_train_transform(size: int = 224) -> Compose:
+    return Compose([
+        Rescale(_resize_min(size)),
+        RandomCrop(size),
+        RandomHorizontalFlip(),
+        ColorJitter(0.4, 0.4, 0.4),
+        ToFloat(),
+        Normalize((0.485, 0.456, 0.406), (0.229, 0.224, 0.225)),
+    ])
+
+
+def imagenet_eval_transform(size: int = 224) -> Compose:
+    return Compose([
+        Rescale(_resize_min(size)),
+        CenterCrop(size),
+        ToFloat(),
+        Normalize((0.485, 0.456, 0.406), (0.229, 0.224, 0.225)),
+    ])

@@ -1,0 +1,76 @@
+"""Metric loggers: the reference's ``loggers`` dict pattern + TensorBoard.
+
+``{metric: {"epochs": [...], "value": [...]}}`` — built at
+ref: ResNet/pytorch/train.py:260-279, appended via ``log_metrics`` (:282-286),
+persisted inside the checkpoint (:427) and re-plotted by notebooks. Kept
+JSON-serializable here so it rides along with the Orbax checkpoint and the
+notebook-replacement plotting scripts can read it directly.
+
+TensorBoard: split train/val writers with per-epoch scalars, matching the
+TF2 reference (ref: YOLO/tensorflow/train.py:196-199,224-241), via
+``tf.summary`` when TensorFlow is importable; silently disabled otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+
+class Loggers:
+    def __init__(self, metrics: list[str] | None = None):
+        self.data: dict[str, dict[str, list]] = {}
+        for m in metrics or []:
+            self._ensure(m)
+
+    def _ensure(self, name: str):
+        self.data.setdefault(name, {"epochs": [], "value": []})
+
+    def log_metrics(self, epoch: int, metrics: dict[str, float]) -> None:
+        for name, value in metrics.items():
+            self._ensure(name)
+            self.data[name]["epochs"].append(int(epoch))
+            self.data[name]["value"].append(float(value))
+
+    def latest(self, name: str):
+        vals = self.data.get(name, {}).get("value", [])
+        return vals[-1] if vals else None
+
+    def to_json(self) -> str:
+        return json.dumps(self.data)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Loggers":
+        out = cls()
+        out.data = json.loads(s)
+        return out
+
+
+class TensorBoardWriter:
+    """Thin tf.summary wrapper; no-op if TF is unavailable."""
+
+    def __init__(self, logdir: str | Path, enabled: bool = True):
+        self._writers = {}
+        self._logdir = Path(logdir)
+        self._tf = None
+        if enabled:
+            try:
+                import tensorflow as tf
+
+                self._tf = tf
+            except ImportError:
+                pass
+
+    def scalar(self, tag: str, value: float, step: int, split: str = "train"):
+        if self._tf is None:
+            return
+        if split not in self._writers:
+            self._writers[split] = self._tf.summary.create_file_writer(
+                str(self._logdir / split)
+            )
+        with self._writers[split].as_default():
+            self._tf.summary.scalar(tag, value, step=step)
+
+    def flush(self):
+        for w in self._writers.values():
+            w.flush()

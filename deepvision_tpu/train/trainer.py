@@ -1,0 +1,193 @@
+"""The Trainer: one epoch-loop harness for the whole zoo.
+
+Re-expresses the reference's copy-pasted per-model ``run_epochs`` /
+``train`` / ``validate`` (ref: ResNet/pytorch/train.py:392-520) as one
+class over the compiled step functions:
+
+- pre-train validation at epoch 0 (ref: train.py:390),
+- per-N-batch running-loss prints (ref: train.py:472-483),
+- top-1/top-5 validation with exact epoch aggregation (ref: :488-520),
+- plateau/step LR scheduling (ref: :412-415),
+- checkpoint every epoch with loggers history inside (ref: :417-428),
+- examples/sec and images/sec/chip (the reference's only throughput
+  metric, ref: YOLO/tensorflow/train.py:212-239, promoted here to a
+  first-class logged metric),
+- TensorBoard split writers.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Callable, Iterable
+
+import jax
+import numpy as np
+
+from deepvision_tpu.core import shard_batch
+from deepvision_tpu.core.step import compile_eval_step, compile_train_step
+from deepvision_tpu.train.checkpoint import CheckpointManager
+from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
+from deepvision_tpu.train.optimizers import make_optimizer, set_lr_scale
+from deepvision_tpu.train.state import create_train_state
+from deepvision_tpu.train.steps import (
+    classification_eval_step,
+    classification_train_step,
+)
+
+
+class Trainer:
+    def __init__(
+        self,
+        model,
+        config: dict,
+        mesh,
+        train_data: Callable[[int], Iterable[dict]],
+        val_data: Callable[[], Iterable[dict]],
+        *,
+        workdir: str | Path = "runs",
+        steps_per_epoch: int | None = None,
+        train_step=classification_train_step,
+        eval_step=classification_eval_step,
+        log_every: int = 10,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.config = config
+        self.mesh = mesh
+        self.train_data = train_data
+        self.val_data = val_data
+        self.workdir = Path(workdir) / config.get("name", "run")
+        self.log_every = log_every
+
+        self.tx, self.plateau = make_optimizer(
+            config, steps_per_epoch or 1000
+        )
+        size = config.get("input_size", 224)
+        sample = np.zeros(
+            (1, size, size, config.get("channels", 3)), np.float32
+        )
+        self.state = create_train_state(model, self.tx, sample, rng=seed)
+        self._train_step = compile_train_step(train_step, mesh)
+        self._eval_step = compile_eval_step(eval_step, mesh)
+        self.loggers = Loggers()
+        self.tb = TensorBoardWriter(self.workdir / "tb")
+        self.ckpt = CheckpointManager(self.workdir / "ckpt")
+        self.start_epoch = 0
+        self.best_metric = -float("inf")
+        self._key = jax.random.key(seed + 1)
+
+    # -- resume ----------------------------------------------------------
+    def resume(self, epoch: int | None = None) -> None:
+        """Restore latest (or given) checkpoint incl. host-side scheduler +
+        metric history — the reference restores model/opt/scheduler/loggers
+        the same way (ref: ResNet/pytorch/train.py:293-307)."""
+        self.state, meta = self.ckpt.restore(self.state, epoch)
+        self.start_epoch = meta["epoch"] + 1
+        if meta.get("loggers"):
+            self.loggers = meta["loggers"]
+        extra = meta.get("extra", {})
+        if self.plateau is not None and "plateau" in extra:
+            self.plateau.load_state_dict(extra["plateau"])
+            self.state = self.state.replace(
+                opt_state=set_lr_scale(self.state.opt_state,
+                                       self.plateau.scale)
+            )
+        if meta.get("best_metric") is not None:
+            self.best_metric = meta["best_metric"]
+
+    # -- loops -----------------------------------------------------------
+    def train_epoch(self, epoch: int) -> dict:
+        t0 = time.perf_counter()
+        n_images = 0
+        running = []
+        metrics = {}
+        for i, batch in enumerate(self.train_data(epoch)):
+            self._key, sub = jax.random.split(self._key)
+            n_images += len(batch["label"])
+            self.state, metrics = self._train_step(
+                self.state, shard_batch(self.mesh, batch), sub
+            )
+            if self.log_every and i % self.log_every == 0:
+                loss = float(metrics["loss"])  # sync point
+                running.append(loss)
+                # running-mean print like the reference
+                # (ref: ResNet/pytorch/train.py:472-483)
+                print(
+                    f"[epoch {epoch} batch {i}] loss={loss:.4f} "
+                    f"running={np.mean(running):.4f}",
+                    flush=True,
+                )
+        # drain the dispatch queue before timing (see bench.py note)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        dt = time.perf_counter() - t0
+        n_chips = self.mesh.devices.size
+        out = {
+            "train_loss": metrics.get("loss", float("nan")),
+            "train_top1": metrics.get("top1", float("nan")),
+            "examples_per_sec": n_images / dt,
+            "images_per_sec_per_chip": n_images / dt / n_chips,
+            "lr_scale": self.plateau.scale if self.plateau else 1.0,
+        }
+        return out
+
+    def validate(self) -> dict:
+        totals = None
+        for batch in self.val_data():
+            part = self._eval_step(self.state, shard_batch(self.mesh, batch))
+            part = {k: float(v) for k, v in part.items()}
+            if totals is None:
+                totals = part
+            else:
+                totals = {k: totals[k] + part[k] for k in totals}
+        if not totals:
+            return {}
+        n = totals.pop("count")
+        return {
+            "val_loss": totals["loss_sum"] / n,
+            "val_top1": totals["top1"] / n,
+            "val_top5": totals.get("top5", 0.0) / n,
+        }
+
+    def fit(self, epochs: int | None = None) -> Loggers:
+        total = epochs or self.config.get("total_epochs", 1)
+        if self.start_epoch == 0:
+            val = self.validate()  # pre-train validation (ref: train.py:390)
+            if val:
+                self.loggers.log_metrics(-1, val)
+                print(f"[pre-train] {_fmt(val)}", flush=True)
+        for epoch in range(self.start_epoch, total):
+            tr = self.train_epoch(epoch)
+            val = self.validate()
+            epoch_metrics = {**tr, **val}
+            self.loggers.log_metrics(epoch, epoch_metrics)
+            for k, v in tr.items():
+                self.tb.scalar(k, v, epoch, "train")
+            for k, v in val.items():
+                self.tb.scalar(k, v, epoch, "val")
+            self.tb.flush()
+            print(f"[epoch {epoch}] {_fmt(epoch_metrics)}", flush=True)
+
+            metric = val.get("val_top1", -tr["train_loss"])
+            if self.plateau is not None:
+                scale = self.plateau.update(metric)
+                if scale != float(
+                    self.state.opt_state.hyperparams["lr_scale"]
+                ):
+                    self.state = self.state.replace(
+                        opt_state=set_lr_scale(self.state.opt_state, scale)
+                    )
+            self.best_metric = max(self.best_metric, metric)
+            self.ckpt.save(
+                epoch,
+                self.state,
+                loggers=self.loggers,
+                extra={"plateau": self.plateau.state_dict()}
+                if self.plateau else {},
+                best_metric=self.best_metric,
+            )
+        return self.loggers
+
+
+def _fmt(d: dict) -> str:
+    return " ".join(f"{k}={v:.4g}" for k, v in d.items())

@@ -1,0 +1,96 @@
+"""Trainer integration: fit/validate/checkpoint/resume + schedules."""
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.core import create_mesh
+from deepvision_tpu.data.mnist import batches, synthetic_mnist
+from deepvision_tpu.models import get_model
+from deepvision_tpu.train.configs import get_config
+from deepvision_tpu.train.schedules import PlateauController, step_decay
+from deepvision_tpu.train.trainer import Trainer
+
+
+@pytest.fixture()
+def mnist_trainer(tmp_path, mesh8):
+    imgs, labels = synthetic_mnist(512)
+    rng = np.random.default_rng(0)
+    cfg = get_config("lenet5")
+    cfg["batch_size"] = 64
+    model = get_model("lenet5")
+    return Trainer(
+        model, cfg, mesh8,
+        lambda e: batches(imgs[64:], labels[64:], 64, rng=rng),
+        lambda: batches(imgs[:64], labels[:64], 64),
+        workdir=tmp_path, steps_per_epoch=7, log_every=0,
+    )
+
+
+def test_fit_and_resume(tmp_path, mesh8, mnist_trainer):
+    trainer = mnist_trainer
+    loggers = trainer.fit(2)
+    assert loggers.latest("val_top1") > 0.5
+    assert loggers.latest("images_per_sec_per_chip") > 0
+    # pre-train validation logged at epoch -1 (ref: train.py:390)
+    assert loggers.data["val_top1"]["epochs"][0] == -1
+    assert trainer.ckpt.latest_epoch() == 1
+
+    # Fresh trainer resumes: epoch counter, metric history, weights.
+    imgs, labels = synthetic_mnist(512)
+    cfg = get_config("lenet5")
+    cfg["batch_size"] = 64
+    rng = np.random.default_rng(1)
+    t2 = Trainer(
+        get_model("lenet5"), cfg, mesh8,
+        lambda e: batches(imgs[64:], labels[64:], 64, rng=rng),
+        lambda: batches(imgs[:64], labels[:64], 64),
+        workdir=tmp_path, steps_per_epoch=7, log_every=0,
+    )
+    t2.resume()
+    assert t2.start_epoch == 2
+    assert t2.loggers.latest("val_top1") == loggers.latest("val_top1")
+    # restored weights carry accuracy without retraining
+    val = t2.validate()
+    assert val["val_top1"] > 0.5
+    t2.fit(3)  # one more epoch from the restored state
+    assert t2.ckpt.latest_epoch() == 2
+
+
+def test_plateau_controller_torch_semantics():
+    c = PlateauController(mode="max", factor=0.1, patience=2)
+    scales = [c.update(m) for m in [0.5, 0.6, 0.6, 0.6, 0.6, 0.7, 0.7, 0.7, 0.7]]
+    # metric 0.6 repeats: bad_epochs 1,2,3>patience -> drop at 5th update
+    assert scales[:4] == [1.0, 1.0, 1.0, 1.0]
+    assert scales[4] == pytest.approx(0.1)
+    # improvement at the 6th update resets the counter; the next three bad
+    # epochs exceed patience again -> second drop (torch: drop when
+    # num_bad_epochs > patience, i.e. on the 3rd bad epoch for patience=2)
+    assert scales[5:8] == [0.1, 0.1, 0.1]
+    assert scales[8] == pytest.approx(0.01)
+
+
+def test_step_decay_schedule():
+    s = step_decay(0.1, steps_per_epoch=10, step_size_epochs=2, gamma=0.5)
+    assert float(s(0)) == pytest.approx(0.1)
+    assert float(s(19)) == pytest.approx(0.1)   # epoch 1
+    assert float(s(20)) == pytest.approx(0.05)  # epoch 2
+    assert float(s(45)) == pytest.approx(0.025)  # epoch 4
+
+
+def test_plateau_changes_effective_lr(tmp_path, mesh8):
+    """After a plateau drop, the injected lr_scale reaches the optimizer."""
+    imgs, labels = synthetic_mnist(256)
+    cfg = get_config("alexnet1")  # plateau config
+    cfg.update(batch_size=32, input_size=32, channels=1, num_classes=10,
+               dataset="mnist")
+    trainer = Trainer(
+        get_model("lenet5"), cfg, mesh8,
+        lambda e: batches(imgs, labels, 32),
+        lambda: batches(imgs[:32], labels[:32], 32),
+        workdir=tmp_path, steps_per_epoch=8, log_every=0,
+    )
+    assert float(trainer.state.opt_state.hyperparams["lr_scale"]) == 1.0
+    trainer.plateau.patience = 0
+    trainer.plateau.best = 2.0  # force "no improvement" every epoch
+    trainer.fit(2)
+    assert float(trainer.state.opt_state.hyperparams["lr_scale"]) < 1.0

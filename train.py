@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Training CLI — surface parity with the reference:
+``python train.py -m resnet50 [-c CKPT_EPOCH]``
+(ref: ResNet/pytorch/train.py:541-562).
+
+Extras over the reference:
+- ``--data-dir`` points at TFRecords/idx files; with no data dir the run
+  uses the synthetic dataset so every config smoke-trains hermetically
+  (generalizing the reference's commented-out synthetic path,
+  ref: CycleGAN/tensorflow/train.py:338-342).
+- ``--epochs`` / ``--batch-size`` / ``--precision`` overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def parse_args():
+    from deepvision_tpu.train.configs import TRAINING_CONFIG
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--model", required=True,
+                   choices=sorted(TRAINING_CONFIG))
+    p.add_argument("-c", "--checkpoint", type=int, default=None,
+                   help="epoch to resume from (default: latest if present)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from latest checkpoint")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--workdir", default="runs")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--synthetic-size", type=int, default=2048,
+                   help="synthetic dataset size when no --data-dir")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core import create_mesh
+    from deepvision_tpu.data.mnist import batches, load_mnist_idx, synthetic_mnist
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.configs import get_config
+    from deepvision_tpu.train.trainer import Trainer
+
+    cfg = get_config(args.model)
+    if args.batch_size:
+        cfg["batch_size"] = args.batch_size
+    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    model = get_model(args.model, dtype=dtype)
+
+    size, ch = cfg["input_size"], cfg["channels"]
+    if args.data_dir and cfg["dataset"] == "imagenet":
+        from deepvision_tpu.data.imagenet import make_imagenet_data
+
+        train_data, val_data, steps = make_imagenet_data(
+            args.data_dir, cfg["batch_size"], size
+        )
+    elif args.data_dir and cfg["dataset"] == "mnist":
+        import os
+
+        tr_i, tr_l = load_mnist_idx(
+            os.path.join(args.data_dir, "train-images-idx3-ubyte"),
+            os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+        )
+        te_i, te_l = load_mnist_idx(
+            os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+        )
+        rng = np.random.default_rng(0)
+        train_data = lambda e: batches(tr_i, tr_l, cfg["batch_size"], rng=rng)
+        val_data = lambda: batches(te_i, te_l, cfg["batch_size"])
+        steps = len(tr_l) // cfg["batch_size"]
+    else:
+        # hermetic synthetic fallback
+        n = args.synthetic_size
+        if cfg["dataset"] == "mnist":
+            imgs, labels = synthetic_mnist(n)
+        else:
+            r = np.random.default_rng(0)
+            labels = r.integers(0, cfg["num_classes"], n).astype(np.int32)
+            imgs = r.normal(0, 1, (n, size, size, ch)).astype(np.float32)
+            for i in range(n):  # make it learnable
+                imgs[i, :, :, 0] += (labels[i] % 7) * 0.3
+        split = max(cfg["batch_size"], int(n * 0.1))
+        rng = np.random.default_rng(0)
+        train_data = lambda e: batches(imgs[split:], labels[split:],
+                                       cfg["batch_size"], rng=rng)
+        val_data = lambda: batches(imgs[:split], labels[:split],
+                                   cfg["batch_size"])
+        steps = (n - split) // cfg["batch_size"]
+
+    mesh = create_mesh()
+    print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
+    trainer = Trainer(
+        model, cfg, mesh, train_data, val_data,
+        workdir=args.workdir, steps_per_epoch=steps,
+    )
+    if args.resume or args.checkpoint is not None:
+        trainer.resume(args.checkpoint)
+        print(f"resumed at epoch {trainer.start_epoch}")
+    trainer.fit(args.epochs)
+
+
+if __name__ == "__main__":
+    main()
