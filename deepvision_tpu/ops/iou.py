@@ -1,0 +1,47 @@
+"""Box coordinate conversions + broadcast IoU (pure jnp, jit-able).
+
+Semantics parity with ref: YOLO/tensorflow/utils.py:4-85 (xywh↔corner
+conversions, gluon-cv-derived broadcast IoU, clipped manual BCE).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def xywh_to_corners(xywh):
+    """[..., (cx, cy, w, h)] -> [..., (x1, y1, x2, y2)]."""
+    xy, wh = xywh[..., :2], xywh[..., 2:4]
+    return jnp.concatenate([xy - wh / 2, xy + wh / 2], axis=-1)
+
+
+def corners_to_xywh(corners):
+    p1, p2 = corners[..., :2], corners[..., 2:4]
+    return jnp.concatenate([(p1 + p2) / 2, p2 - p1], axis=-1)
+
+
+def broadcast_iou(box_a, box_b):
+    """IoU of every a-box against every b-box.
+
+    box_a: (..., A, 4) corners; box_b: (..., B, 4) corners -> (..., A, B).
+    """
+    a = box_a[..., :, None, :]
+    b = box_b[..., None, :, :]
+    inter_lo = jnp.maximum(a[..., :2], b[..., :2])
+    inter_hi = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    inter_wh = jnp.maximum(inter_hi - inter_lo, 0.0)
+    inter = inter_wh[..., 0] * inter_wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * jnp.maximum(
+        a[..., 3] - a[..., 1], 0.0
+    )
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(
+        b[..., 3] - b[..., 1], 0.0
+    )
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+
+
+def binary_cross_entropy(pred_prob, labels, *, eps: float = 1e-7):
+    """Clipped elementwise BCE on probabilities
+    (ref: YOLO/tensorflow/utils.py binary_cross_entropy)."""
+    p = jnp.clip(pred_prob, eps, 1.0 - eps)
+    return -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))

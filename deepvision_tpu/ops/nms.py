@@ -1,0 +1,74 @@
+"""Fixed-shape batched non-maximum suppression for XLA.
+
+The reference NMS is a per-image ``tf.map_fn`` with dynamic greedy loops
+(ref: YOLO/tensorflow/postprocess.py:38-96) — uncompilable on TPU. Here the
+same greedy-suppression semantics are expressed with static shapes:
+
+1. top-K prefilter by score (score_thresh applied as -inf masking),
+2. K×K IoU matrix once,
+3. ``lax.fori_loop`` over K slots: the i-th best survivor kills all
+   lower-scored boxes overlapping it above the threshold.
+
+O(K²) on the VPU beats a data-dependent loop on TPU for K ≤ a few hundred
+(max 100 detections, matching the reference). vmapped over the batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepvision_tpu.ops.iou import broadcast_iou
+
+
+def nms_indices(
+    boxes, scores, *, iou_thresh: float = 0.5, score_thresh: float = 0.5,
+    max_out: int = 100,
+):
+    """boxes (N,4) corners, scores (N,) ->
+    (idx (K,) int32 into the input, scores (K,), valid (K,) bool), K=max_out.
+    Survivors are compacted to the front in score order; padded slots have
+    valid=False, score=0, idx=0."""
+    n = boxes.shape[0]
+    k = min(max_out, n)
+    masked = jnp.where(scores >= score_thresh, scores, -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(masked, k)
+    iou = broadcast_iou(boxes[top_idx], boxes[top_idx])  # (k, k)
+
+    def body(i, alive):
+        kill = (iou[i] > iou_thresh) & (jnp.arange(k) > i) & alive[i]
+        return alive & ~kill
+
+    alive = jax.lax.fori_loop(0, k, body, top_scores > -jnp.inf)
+    order = jnp.argsort(~alive, stable=True)  # survivors first, score order
+    idx = top_idx[order]
+    out_scores = jnp.where(alive, top_scores, 0.0)[order]
+    valid = alive[order]
+    if k < max_out:
+        pad = max_out - k
+        idx = jnp.pad(idx, (0, pad))
+        out_scores = jnp.pad(out_scores, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+    return idx, out_scores, valid
+
+
+def batched_nms(boxes, scores, classes, *, iou_thresh=0.5, score_thresh=0.5,
+                max_out=100):
+    """Class-agnostic greedy suppression over a batch (the reference's
+    Postprocessor behavior — ref: postprocess.py:6-96).
+
+    boxes (B,N,4), scores (B,N), classes (B,N) ->
+    (boxes (B,K,4), scores (B,K), classes (B,K), valid (B,K)).
+    """
+
+    def one(b, s, c):
+        idx, out_scores, valid = nms_indices(
+            b, s, iou_thresh=iou_thresh, score_thresh=score_thresh,
+            max_out=max_out,
+        )
+        zero = jnp.zeros_like(valid)
+        out_boxes = jnp.where(valid[:, None], b[idx], 0.0)
+        out_classes = jnp.where(valid, c[idx], zero.astype(c.dtype))
+        return out_boxes, out_scores, out_classes, valid
+
+    return jax.vmap(one)(boxes, scores, classes)

@@ -1,0 +1,84 @@
+"""YOLO v3 ground-truth grid encoder — fixed-shape, on-device.
+
+The reference encodes labels on the host with ``TensorArray`` dynamic loops
+and ``tensor_scatter_nd_update`` per image (ref:
+YOLO/tensorflow/preprocess.py:137-269). TPU-first re-expression: the encoder
+is a pure jnp function over PADDED boxes (B, MAX_BOXES, 4+1) that runs
+INSIDE the jitted train step — one vectorized scatter per scale, padded
+entries dropped via out-of-bounds indices (XLA scatter drop semantics).
+
+Semantics parity:
+- best-anchor assignment by centered wh-IoU against all 9 anchors
+  (ref: preprocess.py:226-269),
+- anchors normalized by 416 (ref: yolov3.py:18-20),
+- grid y_true layout (x, y, w, h, obj, one-hot classes) with xywh relative
+  to the full image (ref: preprocess.py:137-224).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# (w, h) / 416 — ref: yolov3.py:18-20
+ANCHORS_WH = (
+    np.array(
+        [[10, 13], [16, 30], [33, 23], [30, 61], [62, 45], [59, 119],
+         [116, 90], [156, 198], [373, 326]],
+        np.float32,
+    )
+    / 416.0
+)
+GRID_SIZES = (52, 26, 13)  # scale 0 = small boxes ... 2 = large
+MAX_BOXES = 100  # true-box cap (ref: yolov3.py:448-454)
+
+
+def best_anchor(wh):
+    """wh (..., 2) normalized -> best of the 9 anchors by centered IoU."""
+    anchors = jnp.asarray(ANCHORS_WH)
+    inter = jnp.minimum(wh[..., None, 0], anchors[:, 0]) * jnp.minimum(
+        wh[..., None, 1], anchors[:, 1]
+    )
+    union = (
+        wh[..., None, 0] * wh[..., None, 1]
+        + anchors[:, 0] * anchors[:, 1]
+        - inter
+    )
+    return jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+
+
+def encode_labels(boxes, labels, num_classes: int, *,
+                  grid_sizes=GRID_SIZES):
+    """boxes (B, M, 4) xywh normalized to [0,1]; labels (B, M) int32 with
+    -1 for padding -> tuple of 3 grids, each
+    (B, S, S, 3, 5 + num_classes) float32.
+    """
+    b, m, _ = boxes.shape
+    anchor_idx = best_anchor(boxes[..., 2:4])  # (B, M) in [0, 9)
+    scale_idx = anchor_idx // 3
+    within = anchor_idx % 3
+    valid = labels >= 0
+
+    batch_idx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, m))
+    onehot = jax.nn.one_hot(jnp.maximum(labels, 0), num_classes)
+    features = jnp.concatenate(
+        [boxes, jnp.ones((b, m, 1), boxes.dtype), onehot], axis=-1
+    )  # (B, M, 5 + C)
+
+    outputs = []
+    for s, size in enumerate(grid_sizes):
+        cell_x = jnp.floor(boxes[..., 0] * size).astype(jnp.int32)
+        cell_y = jnp.floor(boxes[..., 1] * size).astype(jnp.int32)
+        cell_x = jnp.clip(cell_x, 0, size - 1)
+        cell_y = jnp.clip(cell_y, 0, size - 1)
+        on_scale = valid & (scale_idx == s)
+        # invalid rows scatter out of bounds -> dropped by XLA
+        oob = jnp.where(on_scale, 0, size + 1)
+        grid = jnp.zeros((b, size, size, 3, features.shape[-1]),
+                         jnp.float32)
+        grid = grid.at[
+            batch_idx, cell_y + oob, cell_x, within
+        ].set(features, mode="drop")
+        outputs.append(grid)
+    return tuple(outputs)
