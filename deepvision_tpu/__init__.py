@@ -13,9 +13,10 @@ as ONE shared library instead of per-model copy-paste:
                  Pallas TPU kernels for the hot spots.
 - ``losses``   : pure-function losses (CE/top-k, YOLO multiscale, heatmap
                  MSE, GAN losses).
-- ``parallel`` : data/spatial/model parallelism over a jax.sharding.Mesh.
 - ``train``    : Trainer, optimizers, LR schedules, checkpointing (Orbax),
-                 metric loggers.
+                 metric loggers.  (Parallelism itself lives in ``core`` —
+                 mesh/shardings — and ``data.device_put`` — multi-host
+                 batch placement.)
 - ``convert``  : PyTorch/TF checkpoint import + layer-for-layer activation
                  diffing against the reference implementations.
 

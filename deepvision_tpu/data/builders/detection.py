@@ -19,6 +19,7 @@ from pathlib import Path
 
 from deepvision_tpu.data.builders.shard_writer import write_sharded
 from deepvision_tpu.data.image_io import ensure_rgb_jpeg
+from deepvision_tpu.data.tfrecord import BytesList, FloatList, Int64List
 
 VOC_CLASSES = (
     "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
@@ -66,15 +67,22 @@ def _detection_features(image_path: Path, ann: dict) -> dict | None:
         "image/height": [ann["height"]],
         "image/width": [ann["width"]],
         "image/filename": [ann["filename"].encode()],
-        "image/object/bbox/xmin": [o["xmin"] for o in objs] or [0.0],
-        "image/object/bbox/ymin": [o["ymin"] for o in objs] or [0.0],
-        "image/object/bbox/xmax": [o["xmax"] for o in objs] or [0.0],
-        "image/object/bbox/ymax": [o["ymax"] for o in objs] or [0.0],
-        "image/object/class/text": [o["name"].encode() for o in objs]
-        or [b""],
-        "image/object/class/label": [o["label"] for o in objs] or [0],
+        # typed lists: images with no objects keep the FloatList/… wire type
+        "image/object/bbox/xmin": FloatList(o["xmin"] for o in objs),
+        "image/object/bbox/ymin": FloatList(o["ymin"] for o in objs),
+        "image/object/bbox/xmax": FloatList(o["xmax"] for o in objs),
+        "image/object/bbox/ymax": FloatList(o["ymax"] for o in objs),
+        "image/object/class/text": BytesList(
+            o["name"].encode() for o in objs
+        ),
+        "image/object/class/label": Int64List(o["label"] for o in objs),
         "image/object/count": [len(objs)],
     }
+
+
+def _detection_item_features(item) -> dict | None:
+    """Module-level (hence Pool-picklable) adapter over (path, ann) items."""
+    return _detection_features(*item)
 
 
 def build_voc_tfrecords(
@@ -89,7 +97,7 @@ def build_voc_tfrecords(
         ann = parse_voc_xml(root / "Annotations" / f"{name}.xml")
         items.append((root / "JPEGImages" / f"{name}.jpg", ann))
     return write_sharded(
-        items, lambda it: _detection_features(*it), output_dir, split,
+        items, _detection_item_features, output_dir, split,
         num_shards=num_shards, num_workers=num_workers,
     )
 
@@ -128,6 +136,6 @@ def build_coco_tfrecords(
                "height": im["height"], "objects": objs}
         items.append((Path(images_dir) / im["file_name"], ann))
     return write_sharded(
-        items, lambda it: _detection_features(*it), output_dir, split,
+        items, _detection_item_features, output_dir, split,
         num_shards=num_shards, num_workers=num_workers,
     )

@@ -24,8 +24,19 @@ from deepvision_tpu.data.folder import load_synset_maps
 from deepvision_tpu.data.image_io import ensure_rgb_jpeg
 
 
-def _make_features_fn(wnid_to_idx, human_map, bboxes):
-    def make_features(path: Path):
+class ImageNetFeatures:
+    """Per-image feature fn; a module-level class (not a closure) so
+    ``multiprocessing.Pool`` can pickle it into worker processes."""
+
+    def __init__(self, wnid_to_idx, human_map, bboxes):
+        self.wnid_to_idx = wnid_to_idx
+        self.human_map = human_map
+        self.bboxes = bboxes
+
+    def __call__(self, path: Path):
+        wnid_to_idx, human_map, bboxes = (
+            self.wnid_to_idx, self.human_map, self.bboxes
+        )
         try:
             data, width, height = ensure_rgb_jpeg(path.read_bytes())
         except Exception:
@@ -52,8 +63,6 @@ def _make_features_fn(wnid_to_idx, human_map, bboxes):
                 ]
             feats["image/object/bbox/label"] = [label] * len(boxes)
         return feats
-
-    return make_features
 
 
 def load_bbox_csv(csv_path: str | Path) -> dict[str, list]:
@@ -96,7 +105,7 @@ def build_imagenet_tfrecords(
     files = sorted(Path(image_dir).glob("*.JPEG"))
     return write_sharded(
         files,
-        _make_features_fn(wnid_to_idx, human_map, bboxes),
+        ImageNetFeatures(wnid_to_idx, human_map, bboxes),
         output_dir, split,
         num_shards=num_shards, num_workers=num_workers,
     )

@@ -1,0 +1,47 @@
+"""Host batch → global device array placement (single- and multi-host).
+
+The reference's multi-device data path is
+``strategy.experimental_distribute_dataset`` (per-replica dataset sharding —
+ref: YOLO/tensorflow/train.py:291-294). TPU-native equivalent: each host's
+``tf.data`` pipeline reads a disjoint file shard
+(``data.imagenet.make_dataset(num_process=, process_index=)``) and the
+process-local numpy batch becomes one **global** ``jax.Array`` spanning the
+mesh via ``jax.make_array_from_process_local_data`` — batch-sharded over
+the ``data`` axis, with XLA collectives riding ICI within a slice and DCN
+across slices.
+
+Single-process (one host, any number of local devices) degenerates to a
+plain sharded ``device_put`` — same call, no branching in user code.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from deepvision_tpu.core.mesh import data_sharding
+
+
+def shard_by_process(mesh, batch):
+    """Per-process local batch pytree -> global batch-sharded jax.Arrays.
+
+    Every participating process must call this with its own local shard of
+    the global batch (local_batch = global_batch / process_count, the
+    reference's ``global_batch = per_replica × replicas`` arithmetic —
+    ref: YOLO/tensorflow/train.py:282).
+    """
+
+    def put(x):
+        x = np.asarray(x)
+        sharding = data_sharding(mesh, x.ndim)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def global_batch_size(mesh, per_device_batch: int) -> int:
+    """per-device batch × all mesh data-axis devices (the reference's
+    global-batch arithmetic, ref: YOLO/tensorflow/train.py:282)."""
+    return per_device_batch * mesh.shape["data"]

@@ -25,6 +25,8 @@ from pathlib import Path
 
 import numpy as np
 
+from deepvision_tpu.data.padding import pad_partial_batch
+
 CHANNEL_MEANS = (123.68, 116.78, 103.94)  # ref: data_load.py:35-38
 RESIZE_MIN = 256
 
@@ -111,11 +113,17 @@ def make_dataset(
     return ds
 
 
-def _as_batches(ds, limit: int | None = None):
+def _as_batches(ds, limit: int | None = None, pad_to: int | None = None):
+    """``pad_to``: pad a final partial batch to that size with a 0/1 mask so
+    every image is evaluated under ONE compiled batch shape (fixes the
+    silent tail-drop the round-1 review flagged)."""
     for i, (img, lbl) in enumerate(ds.as_numpy_iterator()):
         if limit is not None and i >= limit:
             return
-        yield {"image": img, "label": lbl}
+        batch = {"image": img, "label": lbl}
+        if pad_to is not None:
+            batch = pad_partial_batch(batch, pad_to)
+        yield batch
 
 
 def make_imagenet_data(
@@ -130,7 +138,6 @@ def make_imagenet_data(
     """
     d = Path(data_dir)
     steps = train_images // batch_size
-    val_steps = val_images // batch_size
 
     def train_data(epoch: int):
         ds = make_dataset(str(d / "train-*"), batch_size, size,
@@ -138,8 +145,10 @@ def make_imagenet_data(
         return _as_batches(ds, steps)
 
     def val_data():
+        # No step limit: the non-repeating eval dataset ends naturally, and
+        # the final partial batch is padded + masked (full 50k coverage).
         ds = make_dataset(str(d / "validation-*"), batch_size, size,
                           is_training=False)
-        return _as_batches(ds, val_steps)
+        return _as_batches(ds, pad_to=batch_size)
 
     return train_data, val_data, steps

@@ -18,6 +18,8 @@ from pathlib import Path
 
 import numpy as np
 
+from deepvision_tpu.data.padding import pad_partial_batch
+
 
 def _read_idx(path: str | Path) -> np.ndarray:
     p = Path(path)
@@ -59,7 +61,12 @@ def synthetic_mnist(
 
 
 def batches(images, labels, batch_size, *, rng=None, drop_remainder=True):
-    """Simple epoch iterator over host arrays."""
+    """Simple epoch iterator over host arrays.
+
+    ``drop_remainder=False`` (the eval path) pads the final partial batch to
+    ``batch_size`` and attaches a 0/1 ``mask`` to every batch, so the whole
+    set is evaluated under one compiled shape.
+    """
     n = len(images)
     idx = np.arange(n)
     if rng is not None:
@@ -67,4 +74,7 @@ def batches(images, labels, batch_size, *, rng=None, drop_remainder=True):
     end = n - n % batch_size if drop_remainder else n
     for s in range(0, end, batch_size):
         sel = idx[s : s + batch_size]
-        yield {"image": images[sel], "label": labels[sel]}
+        batch = {"image": images[sel], "label": labels[sel]}
+        if not drop_remainder:
+            batch = pad_partial_batch(batch, batch_size)
+        yield batch

@@ -1,0 +1,34 @@
+"""Final-partial-batch padding with an evaluation mask.
+
+One shared implementation for every eval pipeline (MNIST host arrays,
+ImageNet tf.data, detection/pose eval): the final partial batch is padded
+to the full compiled batch shape and a 0/1 ``mask`` row-validity vector is
+attached, so exact full-set evaluation needs only ONE compiled step shape
+(eval steps weight their per-sample sums by the mask).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pad_partial_batch(batch: dict, batch_size: int) -> dict:
+    """Pad every array in ``batch`` along axis 0 to ``batch_size`` and
+    attach ``mask`` ((batch_size,) float32, 1=real row, 0=padding).
+
+    Arrays must share the same leading length ≤ ``batch_size``.
+    """
+    n = len(next(iter(batch.values())))
+    if n > batch_size:
+        raise ValueError(f"batch of {n} exceeds pad target {batch_size}")
+    pad = batch_size - n
+    out = {}
+    for key, value in batch.items():
+        value = np.asarray(value)
+        if pad:
+            value = np.pad(value, ((0, pad),) + ((0, 0),) * (value.ndim - 1))
+        out[key] = value
+    mask = np.ones(batch_size, np.float32)
+    mask[n:] = 0.0
+    out["mask"] = mask
+    return out

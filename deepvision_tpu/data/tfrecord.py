@@ -147,27 +147,66 @@ def _ld(num: int, payload: bytes) -> bytes:  # length-delimited field
     return _field(num, 2) + _varint(len(payload)) + payload
 
 
+class FloatList(list):
+    """Typed wrapper: encodes as FloatList even when empty."""
+
+
+class Int64List(list):
+    """Typed wrapper: encodes as Int64List even when empty."""
+
+
+class BytesList(list):
+    """Typed wrapper: encodes as BytesList even when empty."""
+
+
 def _encode_feature(value) -> bytes:
     """value: list of bytes/str -> BytesList; float -> FloatList;
-    int -> Int64List."""
+    int -> Int64List.
+
+    The typed wrappers (``FloatList``/``Int64List``/``BytesList``) are
+    authoritative: they fix the wire type regardless of element Python
+    types (``FloatList([3, 5])`` still encodes floats) and they are the
+    only way to encode an intentionally-empty feature — an empty untyped
+    list raises instead of guessing, so ``tf.io.parse`` with a typed
+    feature spec never sees a wire-type flip between records.
+    """
     if not isinstance(value, (list, tuple)):
         value = [value]
-    if not value:
-        return _ld(3, b"")  # empty Int64List
-    first = value[0]
-    if isinstance(first, (bytes, str)):
+
+    def as_bytes():
         items = b"".join(
             _ld(1, v.encode() if isinstance(v, str) else v) for v in value
         )
         return _ld(1, items)  # BytesList at field 1
-    if isinstance(first, float):
-        packed = struct.pack(f"<{len(value)}f", *value)
+
+    def as_floats():
+        packed = struct.pack(f"<{len(value)}f", *map(float, value))
         return _ld(2, _ld(1, packed))  # FloatList(packed) at field 2
-    if isinstance(first, (int, bool)):
+
+    def as_ints():
         packed = b"".join(
-            _varint(v & 0xFFFFFFFFFFFFFFFF) for v in value
+            _varint(int(v) & 0xFFFFFFFFFFFFFFFF) for v in value
         )
         return _ld(3, _ld(1, packed))  # Int64List(packed) at field 3
+
+    if isinstance(value, BytesList):
+        return as_bytes()
+    if isinstance(value, FloatList):
+        return as_floats()
+    if isinstance(value, Int64List):
+        return as_ints()
+    if not value:
+        raise TypeError(
+            "empty untyped feature list: wrap with tfrecord.FloatList/"
+            "Int64List/BytesList to fix the wire type"
+        )
+    first = value[0]
+    if isinstance(first, (bytes, str)):
+        return as_bytes()
+    if isinstance(first, float):
+        return as_floats()
+    if isinstance(first, (int, bool)):
+        return as_ints()
     raise TypeError(f"unsupported feature value type {type(first)}")
 
 

@@ -17,26 +17,33 @@ import jax.numpy as jnp
 import optax
 
 
-def softmax_cross_entropy(logits, labels, *, label_smoothing: float = 0.0):
-    """Mean CE over the batch. ``labels`` are int32 class ids."""
+def softmax_cross_entropy_per_sample(
+    logits, labels, *, label_smoothing: float = 0.0
+):
+    """Per-sample CE losses (B,). ``labels`` are int32 class ids."""
     logits = logits.astype(jnp.float32)
     if label_smoothing:
         num_classes = logits.shape[-1]
         onehot = jnp.eye(num_classes, dtype=jnp.float32)[labels]
-        losses = optax.softmax_cross_entropy(
+        return optax.softmax_cross_entropy(
             logits, optax.smooth_labels(onehot, label_smoothing)
         )
-    else:
-        losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    return losses.mean()
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def softmax_cross_entropy(logits, labels, *, label_smoothing: float = 0.0):
+    """Mean CE over the batch. ``labels`` are int32 class ids."""
+    return softmax_cross_entropy_per_sample(
+        logits, labels, label_smoothing=label_smoothing
+    ).mean()
 
 
 # Alias used throughout the trainers.
 cross_entropy_loss = softmax_cross_entropy
 
 
-def topk_accuracy(logits, labels, ks=(1, 5)):
-    """dict of top-k accuracies (fractions in [0,1]).
+def topk_correct(logits, labels, ks=(1, 5)):
+    """dict of per-sample top-k hit indicators (B,) float32.
 
     ref: ResNet/pytorch/train.py:523-538 computes top-1/top-5 with
     ``torch.topk``; same semantics here via a rank comparison (the true
@@ -45,4 +52,9 @@ def topk_accuracy(logits, labels, ks=(1, 5)):
     logits = logits.astype(jnp.float32)
     target_scores = jnp.take_along_axis(logits, labels[:, None], axis=-1)
     rank = jnp.sum(logits > target_scores, axis=-1)
-    return {f"top{k}": jnp.mean((rank < k).astype(jnp.float32)) for k in ks}
+    return {f"top{k}": (rank < k).astype(jnp.float32) for k in ks}
+
+
+def topk_accuracy(logits, labels, ks=(1, 5)):
+    """dict of top-k accuracies (fractions in [0,1])."""
+    return {k: v.mean() for k, v in topk_correct(logits, labels, ks).items()}

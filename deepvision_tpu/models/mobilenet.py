@@ -12,8 +12,9 @@ proper float multiplier (paper semantics, channels rounded to int, min 8)
 applied uniformly.
 
 Depthwise convs are one of the Pallas-kernel candidates (SURVEY §2.5): XLA
-lowers ``feature_group_count=C`` convs to the VPU rather than the MXU; see
-ops/pallas for the fused DW kernel used on TPU.
+lowers ``feature_group_count=C`` convs to the VPU rather than the MXU, so a
+fused Pallas DW kernel is a planned (NOT yet implemented) optimization; the
+current path relies on XLA's native lowering.
 """
 
 from __future__ import annotations

@@ -23,14 +23,22 @@ from deepvision_tpu.ops.iou import broadcast_iou
 
 def nms_indices(
     boxes, scores, *, iou_thresh: float = 0.5, score_thresh: float = 0.5,
-    max_out: int = 100,
+    max_out: int = 100, candidate_cap: int = 512,
 ):
     """boxes (N,4) corners, scores (N,) ->
     (idx (K,) int32 into the input, scores (K,), valid (K,) bool), K=max_out.
     Survivors are compacted to the front in score order; padded slots have
-    valid=False, score=0, idx=0."""
+    valid=False, score=0, idx=0.
+
+    Greedy suppression runs over the top-``candidate_cap`` scored boxes
+    (bounding the IoU matrix at cap², the fixed-shape price of XLA), then
+    the first ``max_out`` survivors are emitted. Exact greedy-NMS parity
+    holds whenever at most ``candidate_cap`` boxes clear ``score_thresh`` —
+    size it accordingly (default 512 ≫ the reference's 100 detections,
+    ref: postprocess.py:38-96).
+    """
     n = boxes.shape[0]
-    k = min(max_out, n)
+    k = min(n, max(candidate_cap, max_out))
     masked = jnp.where(scores >= score_thresh, scores, -jnp.inf)
     top_scores, top_idx = jax.lax.top_k(masked, k)
     iou = broadcast_iou(boxes[top_idx], boxes[top_idx])  # (k, k)
@@ -41,9 +49,9 @@ def nms_indices(
 
     alive = jax.lax.fori_loop(0, k, body, top_scores > -jnp.inf)
     order = jnp.argsort(~alive, stable=True)  # survivors first, score order
-    idx = top_idx[order]
-    out_scores = jnp.where(alive, top_scores, 0.0)[order]
-    valid = alive[order]
+    idx = top_idx[order][:max_out]
+    out_scores = jnp.where(alive, top_scores, 0.0)[order][:max_out]
+    valid = alive[order][:max_out]
     if k < max_out:
         pad = max_out - k
         idx = jnp.pad(idx, (0, pad))
@@ -53,7 +61,7 @@ def nms_indices(
 
 
 def batched_nms(boxes, scores, classes, *, iou_thresh=0.5, score_thresh=0.5,
-                max_out=100):
+                max_out=100, candidate_cap=512):
     """Class-agnostic greedy suppression over a batch (the reference's
     Postprocessor behavior — ref: postprocess.py:6-96).
 
@@ -64,7 +72,7 @@ def batched_nms(boxes, scores, classes, *, iou_thresh=0.5, score_thresh=0.5,
     def one(b, s, c):
         idx, out_scores, valid = nms_indices(
             b, s, iou_thresh=iou_thresh, score_thresh=score_thresh,
-            max_out=max_out,
+            max_out=max_out, candidate_cap=candidate_cap,
         )
         zero = jnp.zeros_like(valid)
         out_boxes = jnp.where(valid[:, None], b[idx], 0.0)

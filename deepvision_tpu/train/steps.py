@@ -15,7 +15,9 @@ import jax.numpy as jnp
 
 from deepvision_tpu.losses.classification import (
     softmax_cross_entropy,
+    softmax_cross_entropy_per_sample,
     topk_accuracy,
+    topk_correct,
 )
 from deepvision_tpu.train.state import TrainState
 
@@ -57,21 +59,27 @@ def classification_train_step(
 
 
 def classification_eval_step(state: TrainState, batch: dict) -> dict:
+    """Count-weighted sums over one batch, for exact epoch aggregation.
+
+    ``batch["mask"]`` (optional, (B,) float 1/0) marks padding rows: the
+    final partial validation batch is padded to full size and masked so the
+    whole 50k-image set is evaluated with one compiled shape — the
+    reference evaluates the full set too (ref: ResNet/pytorch/train.py:488-520).
+    """
     images, labels = batch["image"], batch["label"]
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones(labels.shape[0], jnp.float32)
     variables: dict[str, Any] = {"params": state.params}
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
     logits = state.apply_fn(variables, images, train=False)
     if isinstance(logits, (tuple, list)):
         logits = logits[0]
-    loss = softmax_cross_entropy(logits, labels)
-    n = jnp.asarray(labels.shape[0], jnp.float32)
-    acc = topk_accuracy(logits, labels)
-    # Return sums so the host can aggregate exactly over a full epoch
-    # (the reference accumulates counts the same way,
-    # ref: ResNet/pytorch/train.py:488-520).
+    losses = softmax_cross_entropy_per_sample(logits, labels)
+    correct = topk_correct(logits, labels)
     return {
-        "loss_sum": loss * n,
-        "count": n,
-        **{k: v * n for k, v in acc.items()},
+        "loss_sum": jnp.sum(losses * mask),
+        "count": jnp.sum(mask),
+        **{k: jnp.sum(v * mask) for k, v in correct.items()},
     }

@@ -99,37 +99,51 @@ class Trainer:
     # -- loops -----------------------------------------------------------
     def train_epoch(self, epoch: int) -> dict:
         t0 = time.perf_counter()
-        n_images = 0
-        running = []
-        metrics = {}
+        counts: list[int] = []
+        pending: list[dict] = []  # device scalars not yet fetched
+        fetched: list[dict] = []  # host floats; each metric fetched ONCE
+
+        def drain():
+            fetched.extend(
+                {k: float(v) for k, v in m.items()} for m in pending
+            )
+            pending.clear()
+
         for i, batch in enumerate(self.train_data(epoch)):
             self._key, sub = jax.random.split(self._key)
-            n_images += len(batch["label"])
+            counts.append(len(batch["label"]))
             self.state, metrics = self._train_step(
                 self.state, shard_batch(self.mesh, batch), sub
             )
+            pending.append(metrics)
             if self.log_every and i % self.log_every == 0:
-                loss = float(metrics["loss"])  # sync point
-                running.append(loss)
-                # running-mean print like the reference
-                # (ref: ResNet/pytorch/train.py:472-483)
+                drain()  # syncs mostly-finished work; O(n) fetches total
+                # true running mean over EVERY batch so far, matching the
+                # reference (ref: ResNet/pytorch/train.py:472-483)
+                running = np.mean([m["loss"] for m in fetched])
                 print(
-                    f"[epoch {epoch} batch {i}] loss={loss:.4f} "
-                    f"running={np.mean(running):.4f}",
+                    f"[epoch {epoch} batch {i}] "
+                    f"loss={fetched[-1]['loss']:.4f} "
+                    f"running={running:.4f}",
                     flush=True,
                 )
-        # drain the dispatch queue before timing (see bench.py note)
-        metrics = {k: float(v) for k, v in metrics.items()}
+        drain()  # drains the dispatch queue — MUST precede the timing read
         dt = time.perf_counter() - t0
+        n_images = sum(counts)
+        w = np.asarray(counts, np.float64)
+        # exact batch-size-weighted epoch aggregates
+        agg = {
+            k: float(np.average([m[k] for m in fetched], weights=w))
+            for k in (fetched[0] if fetched else {})
+        }
         n_chips = self.mesh.devices.size
-        out = {
-            "train_loss": metrics.get("loss", float("nan")),
-            "train_top1": metrics.get("top1", float("nan")),
+        return {
+            "train_loss": agg.get("loss", float("nan")),
+            "train_top1": agg.get("top1", float("nan")),
             "examples_per_sec": n_images / dt,
             "images_per_sec_per_chip": n_images / dt / n_chips,
             "lr_scale": self.plateau.scale if self.plateau else 1.0,
         }
-        return out
 
     def validate(self) -> dict:
         totals = None

@@ -1,0 +1,296 @@
+"""Fixtures for the detection leaf ops: IoU, NMS, YOLO label encoder.
+
+NMS semantics are pinned against an independent numpy greedy reference
+(the reference's per-image dynamic-loop behavior —
+ref: YOLO/tensorflow/postprocess.py:38-96); the encoder against hand-placed
+boxes with known best anchors (ref: YOLO/tensorflow/preprocess.py:137-269).
+"""
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.ops.iou import (
+    broadcast_iou,
+    binary_cross_entropy,
+    corners_to_xywh,
+    xywh_to_corners,
+)
+from deepvision_tpu.ops.nms import batched_nms, nms_indices
+from deepvision_tpu.ops.yolo_encode import (
+    ANCHORS_WH,
+    GRID_SIZES,
+    best_anchor,
+    encode_labels,
+)
+
+
+# ---------------------------------------------------------------- IoU
+
+
+def test_iou_identical_and_disjoint():
+    a = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    b = np.array(
+        [[0.0, 0.0, 1.0, 1.0], [2.0, 2.0, 3.0, 3.0]], np.float32
+    )
+    iou = np.asarray(broadcast_iou(a, b))
+    np.testing.assert_allclose(iou, [[1.0, 0.0]], atol=1e-6)
+
+
+def test_iou_partial_overlap_hand_computed():
+    # [0,0,2,2] vs [1,1,3,3]: inter=1, union=4+4-1=7
+    a = np.array([[0.0, 0.0, 2.0, 2.0]], np.float32)
+    b = np.array([[1.0, 1.0, 3.0, 3.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(broadcast_iou(a, b)), [[1 / 7]], rtol=1e-6
+    )
+
+
+def test_iou_degenerate_zero_area():
+    a = np.array([[0.5, 0.5, 0.5, 0.5]], np.float32)  # zero-area box
+    b = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    iou = np.asarray(broadcast_iou(a, b))
+    assert np.all(np.isfinite(iou)) and iou[0, 0] == pytest.approx(0.0)
+
+
+def test_iou_inverted_corners_clamped():
+    a = np.array([[1.0, 1.0, 0.0, 0.0]], np.float32)  # x2<x1, y2<y1
+    b = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    iou = np.asarray(broadcast_iou(a, b))
+    assert np.all(np.isfinite(iou)) and iou[0, 0] >= 0.0
+
+
+def test_iou_broadcast_shape():
+    a = np.zeros((2, 5, 4), np.float32)
+    b = np.zeros((2, 7, 4), np.float32)
+    assert broadcast_iou(a, b).shape == (2, 5, 7)
+
+
+def test_xywh_roundtrip(rng):
+    xywh = np.abs(rng.normal(size=(10, 4))).astype(np.float32) + 0.1
+    back = np.asarray(corners_to_xywh(xywh_to_corners(xywh)))
+    np.testing.assert_allclose(back, xywh, rtol=1e-5, atol=1e-6)
+
+
+def test_bce_matches_formula():
+    p = np.array([0.1, 0.9, 0.5], np.float32)
+    y = np.array([0.0, 1.0, 1.0], np.float32)
+    expect = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    np.testing.assert_allclose(
+        np.asarray(binary_cross_entropy(p, y)), expect, rtol=1e-5
+    )
+
+
+def test_bce_saturated_probs_finite():
+    p = np.array([0.0, 1.0], np.float32)
+    y = np.array([1.0, 0.0], np.float32)
+    assert np.all(np.isfinite(np.asarray(binary_cross_entropy(p, y))))
+
+
+# ---------------------------------------------------------------- NMS
+
+
+def greedy_nms_reference(boxes, scores, iou_thresh, score_thresh, max_out):
+    """Independent numpy greedy NMS (descending score, stable ties)."""
+    order = np.argsort(-scores, kind="stable")
+    order = [i for i in order if scores[i] >= score_thresh]
+    keep = []
+    for i in order:
+        ok = True
+        for j in keep:
+            iou = float(
+                np.asarray(
+                    broadcast_iou(boxes[None, i], boxes[None, j])
+                )[0, 0]
+            )
+            if iou > iou_thresh:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+        if len(keep) == max_out:
+            break
+    return keep
+
+
+def _random_boxes(rng, n):
+    centers = rng.uniform(0.1, 0.9, size=(n, 2))
+    sizes = rng.uniform(0.05, 0.4, size=(n, 2))
+    return np.concatenate(
+        [centers - sizes / 2, centers + sizes / 2], axis=-1
+    ).astype(np.float32)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_nms_matches_greedy_reference(seed):
+    rng = np.random.default_rng(seed)
+    n = 40
+    boxes = _random_boxes(rng, n)
+    scores = rng.uniform(0.0, 1.0, size=n).astype(np.float32)
+    idx, out_scores, valid = nms_indices(
+        boxes, scores, iou_thresh=0.5, score_thresh=0.3, max_out=n
+    )
+    got = list(np.asarray(idx)[np.asarray(valid)])
+    expect = greedy_nms_reference(boxes, scores, 0.5, 0.3, n)
+    assert got == expect
+
+
+def test_nms_tied_scores_deterministic():
+    boxes = np.array(
+        [
+            [0.0, 0.0, 1.0, 1.0],
+            [0.05, 0.0, 1.05, 1.0],  # high overlap with box 0
+            [2.0, 2.0, 3.0, 3.0],
+        ],
+        np.float32,
+    )
+    scores = np.array([0.9, 0.9, 0.9], np.float32)  # all tied
+    idx, _, valid = nms_indices(
+        boxes, scores, iou_thresh=0.5, score_thresh=0.1, max_out=3
+    )
+    got = list(np.asarray(idx)[np.asarray(valid)])
+    # ties break by input order (lowest index first), like top_k
+    assert got == [0, 2]
+
+
+def test_nms_padding_contract():
+    boxes = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    scores = np.array([0.9], np.float32)
+    idx, out_scores, valid = nms_indices(
+        boxes, scores, iou_thresh=0.5, score_thresh=0.5, max_out=5
+    )
+    assert idx.shape == (5,) and out_scores.shape == (5,)
+    assert list(np.asarray(valid)) == [True, False, False, False, False]
+    np.testing.assert_array_equal(np.asarray(out_scores)[1:], 0.0)
+
+
+def test_nms_all_below_score_thresh():
+    boxes = _random_boxes(np.random.default_rng(0), 8)
+    scores = np.full(8, 0.1, np.float32)
+    _, out_scores, valid = nms_indices(
+        boxes, scores, iou_thresh=0.5, score_thresh=0.5, max_out=8
+    )
+    assert not np.asarray(valid).any()
+    np.testing.assert_array_equal(np.asarray(out_scores), 0.0)
+
+
+def test_nms_max_out_truncates():
+    rng = np.random.default_rng(7)
+    # far-apart boxes: nothing suppresses anything
+    boxes = np.stack(
+        [
+            np.arange(10, dtype=np.float32) * 3,
+            np.zeros(10, np.float32),
+            np.arange(10, dtype=np.float32) * 3 + 1,
+            np.ones(10, np.float32),
+        ],
+        axis=-1,
+    )
+    scores = rng.uniform(0.6, 1.0, size=10).astype(np.float32)
+    idx, _, valid = nms_indices(
+        boxes, scores, iou_thresh=0.5, score_thresh=0.5, max_out=4
+    )
+    got = list(np.asarray(idx)[np.asarray(valid)])
+    expect = greedy_nms_reference(boxes, scores, 0.5, 0.5, 4)
+    assert got == expect and len(got) == 4
+
+
+def test_batched_nms_shapes_and_zeroed_padding(rng):
+    b, n, k = 3, 20, 10
+    boxes = np.stack([_random_boxes(rng, n) for _ in range(b)])
+    scores = rng.uniform(0, 1, size=(b, n)).astype(np.float32)
+    classes = rng.integers(0, 5, size=(b, n)).astype(np.int32)
+    ob, os_, oc, valid = batched_nms(
+        boxes, scores, classes, iou_thresh=0.5, score_thresh=0.4, max_out=k
+    )
+    assert ob.shape == (b, k, 4) and os_.shape == (b, k)
+    assert oc.shape == (b, k) and valid.shape == (b, k)
+    inv = ~np.asarray(valid)
+    assert np.all(np.asarray(ob)[inv] == 0)
+    assert np.all(np.asarray(oc)[inv] == 0)
+    # per-image agreement with the reference
+    for i in range(b):
+        got = [
+            int(x)
+            for x in np.asarray(
+                nms_indices(
+                    boxes[i], scores[i],
+                    iou_thresh=0.5, score_thresh=0.4, max_out=k,
+                )[0]
+            )[np.asarray(valid[i])]
+        ]
+        assert got == greedy_nms_reference(boxes[i], scores[i], 0.5, 0.4, k)
+
+
+# ------------------------------------------------------- YOLO encoder
+
+
+def test_best_anchor_exact_matches():
+    # wh exactly equal to an anchor → that anchor wins
+    for a in (0, 4, 8):
+        wh = ANCHORS_WH[a][None]
+        assert int(np.asarray(best_anchor(wh))[0]) == a
+
+
+def test_encode_places_feature_in_correct_cell():
+    # large box (~anchor 8: 373x326/416) centered at (0.5, 0.25)
+    boxes = np.zeros((1, 3, 4), np.float32)
+    labels = np.full((1, 3), -1, np.int32)
+    boxes[0, 0] = [0.5, 0.25, 373 / 416, 326 / 416]
+    labels[0, 0] = 2
+    grids = encode_labels(boxes, labels, num_classes=5)
+    assert len(grids) == len(GRID_SIZES)
+    g = np.asarray(grids[2])  # anchor 8 → scale 2 (13x13)
+    size = GRID_SIZES[2]
+    cy, cx = int(0.25 * size), int(0.5 * size)
+    anchor_within = 8 % 3
+    cell = g[0, cy, cx, anchor_within]
+    np.testing.assert_allclose(
+        cell[:4], boxes[0, 0], rtol=1e-6
+    )  # xywh stored
+    assert cell[4] == 1.0  # objectness
+    np.testing.assert_array_equal(cell[5:], np.eye(5)[2])  # one-hot
+    # exactly one populated cell across all scales
+    total = sum(float(np.asarray(s)[..., 4].sum()) for s in grids)
+    assert total == 1.0
+
+
+def test_encode_small_box_lands_on_fine_grid():
+    boxes = np.zeros((1, 1, 4), np.float32)
+    boxes[0, 0] = [0.1, 0.9, 10 / 416, 13 / 416]  # anchor 0 → scale 0 (52)
+    labels = np.zeros((1, 1), np.int32)
+    grids = encode_labels(boxes, labels, num_classes=3)
+    g = np.asarray(grids[0])
+    size = GRID_SIZES[0]
+    assert g[0, int(0.9 * size), int(0.1 * size), 0, 4] == 1.0
+    assert float(np.asarray(grids[1]).sum()) == 0.0
+    assert float(np.asarray(grids[2]).sum()) == 0.0
+
+
+def test_encode_padding_rows_dropped():
+    boxes = np.random.default_rng(0).uniform(
+        0.2, 0.8, size=(2, 4, 4)
+    ).astype(np.float32)
+    labels = np.full((2, 4), -1, np.int32)  # ALL padding
+    grids = encode_labels(boxes, labels, num_classes=3)
+    for g in grids:
+        assert float(np.asarray(g).sum()) == 0.0
+
+
+def test_encode_boundary_cell_clipped():
+    boxes = np.zeros((1, 1, 4), np.float32)
+    boxes[0, 0] = [1.0, 1.0, 116 / 416, 90 / 416]  # center on far edge
+    labels = np.zeros((1, 1), np.int32)
+    grids = encode_labels(boxes, labels, num_classes=2)
+    g = np.asarray(grids[2])
+    size = GRID_SIZES[2]
+    assert g[0, size - 1, size - 1, 6 % 3, 4] == 1.0  # clipped into last cell
+
+
+def test_encode_batch_isolation():
+    boxes = np.zeros((2, 1, 4), np.float32)
+    boxes[0, 0] = [0.5, 0.5, 116 / 416, 90 / 416]
+    boxes[1, 0] = [0.5, 0.5, 116 / 416, 90 / 416]
+    labels = np.array([[0], [-1]], np.int32)  # image 1 has no boxes
+    grids = encode_labels(boxes, labels, num_classes=2)
+    g = np.asarray(grids[2])
+    assert g[0].sum() > 0 and g[1].sum() == 0

@@ -76,7 +76,8 @@ def main():
         )
         rng = np.random.default_rng(0)
         train_data = lambda e: batches(tr_i, tr_l, cfg["batch_size"], rng=rng)
-        val_data = lambda: batches(te_i, te_l, cfg["batch_size"])
+        val_data = lambda: batches(te_i, te_l, cfg["batch_size"],
+                                   drop_remainder=False)
         steps = len(tr_l) // cfg["batch_size"]
     else:
         # hermetic synthetic fallback
@@ -94,7 +95,7 @@ def main():
         train_data = lambda e: batches(imgs[split:], labels[split:],
                                        cfg["batch_size"], rng=rng)
         val_data = lambda: batches(imgs[:split], labels[:split],
-                                   cfg["batch_size"])
+                                   cfg["batch_size"], drop_remainder=False)
         steps = (n - split) // cfg["batch_size"]
 
     mesh = create_mesh()
