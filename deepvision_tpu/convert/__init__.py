@@ -1,0 +1,28 @@
+"""Checkpoint conversion + activation diffing (SURVEY §5.4 north star).
+
+- ``torch_import``: reference PyTorch checkpoints (dict-of-everything,
+  DataParallel prefixes, NCHW) → Flax variables.
+- ``keras_import``: Keras HDF5 (per-epoch full-model saves,
+  keras-applications pretrained files) → Flax variables.
+- ``diff``: layer-for-layer activation comparison between the converted
+  Flax model and the source torch module.
+"""
+
+from deepvision_tpu.convert.diff import diff_activations, resnet_name_map
+from deepvision_tpu.convert.keras_import import keras_h5_to_flax
+from deepvision_tpu.convert.torch_import import (
+    load_torch_checkpoint,
+    resnet_torch_to_flax,
+    strip_module_prefix,
+    torch_to_flax,
+)
+
+__all__ = [
+    "diff_activations",
+    "resnet_name_map",
+    "keras_h5_to_flax",
+    "load_torch_checkpoint",
+    "resnet_torch_to_flax",
+    "strip_module_prefix",
+    "torch_to_flax",
+]

@@ -1,0 +1,117 @@
+"""Layer-for-layer activation diffing (the north-star verification tool).
+
+``diff_activations`` runs the SAME image through the Flax model (with
+``capture_intermediates``) and a source torch module (with forward hooks),
+aligns activations by a name map, and reports max-abs-error per layer —
+the tool the reference never had for checking its own pytorch↔tensorflow
+pairs (SURVEY §0 north star).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import numpy as np
+
+
+def _flax_intermediates(model, variables, image_nhwc) -> dict[str, np.ndarray]:
+    _, state = model.apply(
+        variables,
+        image_nhwc,
+        train=False,
+        capture_intermediates=True,
+        mutable=["intermediates"],
+    )
+
+    flat = {}
+
+    def walk(node, path):
+        if isinstance(node, Mapping):
+            for k, v in node.items():
+                walk(v, path + (k,))
+        else:
+            name = "/".join(p for p in path if p != "__call__")
+            for leaf in (
+                node if isinstance(node, (tuple, list)) else (node,)
+            ):
+                if hasattr(leaf, "shape"):
+                    flat.setdefault(name, np.asarray(leaf))
+
+    walk(state["intermediates"], ())
+    return flat
+
+
+def _torch_intermediates(module, image_nchw) -> dict[str, np.ndarray]:
+    import torch
+
+    acts: dict[str, np.ndarray] = {}
+    hooks = []
+    for name, sub in module.named_modules():
+        if name:
+            hooks.append(
+                sub.register_forward_hook(
+                    lambda m, i, o, name=name: acts.__setitem__(
+                        name,
+                        o.detach().cpu().numpy()
+                        if hasattr(o, "detach") else None,
+                    )
+                )
+            )
+    try:
+        module.eval()
+        with torch.no_grad():
+            out = module(torch.from_numpy(image_nchw))
+        acts["__output__"] = out.detach().cpu().numpy()
+    finally:
+        for h in hooks:
+            h.remove()
+    return acts
+
+
+def _nchw_to_nhwc(a: np.ndarray) -> np.ndarray:
+    return a.transpose(0, 2, 3, 1) if a.ndim == 4 else a
+
+
+def diff_activations(
+    model, variables, torch_module, image_nhwc, name_map: Mapping[str, str]
+) -> dict[str, float]:
+    """-> {flax layer name: max abs err vs the mapped torch module output}.
+
+    ``name_map``: flax intermediate path (e.g. ``"stage1_block1"``) →
+    torch module name (e.g. ``"conv2x.0"``). The special flax key
+    ``"__output__"`` compares final outputs.
+    """
+    image_nhwc = np.asarray(image_nhwc, np.float32)
+    flax_acts = _flax_intermediates(model, variables, image_nhwc)
+    flax_acts["__output__"] = np.asarray(
+        model.apply(variables, image_nhwc, train=False)
+    )
+    torch_acts = _torch_intermediates(
+        torch_module, image_nhwc.transpose(0, 3, 1, 2)
+    )
+    report = {}
+    for flax_name, torch_name in name_map.items():
+        a = flax_acts.get(flax_name)
+        b = torch_acts.get(torch_name)
+        if a is None or b is None:
+            report[flax_name] = float("nan")
+            continue
+        b = _nchw_to_nhwc(b)
+        if a.shape != b.shape:
+            report[flax_name] = float("inf")
+            continue
+        report[flax_name] = float(
+            np.max(np.abs(a.astype(np.float32) - b.astype(np.float32)))
+        )
+    return report
+
+
+def resnet_name_map(stage_sizes=(3, 4, 6, 3)) -> dict[str, str]:
+    """Default flax→torch map for the reference ResNet family
+    (block outputs + logits)."""
+    out = {"__output__": "__output__"}
+    for s, n in enumerate(stage_sizes):
+        for j in range(n):
+            out[f"stage{s + 1}_block{j + 1}"] = f"conv{s + 2}x.{j}"
+    return out

@@ -1,0 +1,95 @@
+"""Keras HDF5 weights → Flax variables.
+
+Covers the reference's two Keras checkpoint forms (SURVEY §5.4):
+full-model/weights HDF5 saved per epoch (ref: ResNet/tensorflow/
+train.py:65-78) and keras-applications pretrained files ingested by hash
+(ref: ResNet/tensorflow/models/resnet50v2.py:137-153). Keras kernels are
+already (KH, KW, I, O) / (I, O) — no transpose; BN gamma/beta/moving_*
+map to scale/bias/mean/var.
+
+The name mapping implemented here is the keras-applications ResNet50V2
+scheme (``conv{s}_block{j}_{k}_conv`` etc.) → ``models.resnet.ResNetV2``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+import numpy as np
+
+from deepvision_tpu.convert.torch_import import _set
+
+
+def _read_h5_weights(path) -> dict[str, np.ndarray]:
+    """save_weights-format HDF5 -> {"layer/weight:0": array}."""
+    import h5py
+
+    out = {}
+
+    def visit(name, obj):
+        if isinstance(obj, h5py.Dataset):
+            out[name] = np.asarray(obj)
+
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        root.visititems(visit)
+    return out
+
+
+_BN_LEAF = {
+    "gamma": ("params", "scale"),
+    "beta": ("params", "bias"),
+    "moving_mean": ("batch_stats", "mean"),
+    "moving_variance": ("batch_stats", "var"),
+}
+_CONV_LEAF = {"kernel": ("params", "kernel"), "bias": ("params", "bias")}
+
+
+def _resnet50v2_key(name: str):
+    """keras dataset path -> (collection, flax path) or None."""
+    # dataset paths look like "conv1_conv/conv1_conv/kernel:0"
+    parts = name.split("/")
+    layer, leaf = parts[0], parts[-1].split(":")[0]
+    m = re.fullmatch(r"conv(\d)_block(\d+)_(preact_bn|\d_conv|\d_bn)", layer)
+    if m:
+        stage, block, rest = m.groups()
+        base = f"stage{int(stage) - 1}_block{block}"
+        if rest == "preact_bn":
+            coll, out_leaf = _BN_LEAF[leaf]
+            return coll, (base, "preact_bn", out_leaf)
+        idx, kind = rest.split("_")
+        if kind == "conv":
+            sub = "proj" if idx == "0" else f"conv{idx}"
+            coll, out_leaf = _CONV_LEAF[leaf]
+            return coll, (base, sub, out_leaf)
+        coll, out_leaf = _BN_LEAF[leaf]
+        return coll, (base, f"bn{idx}", out_leaf)
+    if layer == "conv1_conv":
+        coll, out_leaf = _CONV_LEAF[leaf]
+        return coll, ("stem", out_leaf)
+    if layer == "post_bn":
+        coll, out_leaf = _BN_LEAF[leaf]
+        return coll, ("post_bn", out_leaf)
+    if layer == "predictions":
+        coll, out_leaf = _CONV_LEAF[leaf]
+        return coll, ("fc", out_leaf)
+    return None
+
+
+def keras_h5_to_flax(
+    path, key_fn: Callable = _resnet50v2_key
+) -> dict:
+    """HDF5 weight file -> {'params': ..., 'batch_stats': ...}."""
+    out: dict[str, dict] = {"params": {}, "batch_stats": {}}
+    misses = []
+    for name, value in _read_h5_weights(path).items():
+        spec = key_fn(name)
+        if spec is None:
+            misses.append(name)
+            continue
+        coll, flax_path = spec
+        _set(out[coll], flax_path, value.astype(np.float32))
+    if misses:
+        raise KeyError(f"unmapped keras weights: {misses[:10]}")
+    return out

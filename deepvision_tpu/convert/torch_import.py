@@ -1,0 +1,143 @@
+"""PyTorch checkpoint → Flax variables (the north-star converter).
+
+Handles the reference's checkpoint-dict-of-everything
+(``{'epoch','model','optimizer','scheduler','loggers'}`` —
+ref: ResNet/pytorch/train.py:417-428), bare state dicts, and the
+``nn.DataParallel`` ``module.`` key prefix
+(ref: ResNet/pytorch/README.md:85-93). Layout conversion:
+
+- conv weights (O, I, KH, KW) → (KH, KW, I, O),
+- linear weights (O, I) → (I, O),
+- BN weight/bias → scale/bias params; running_mean/var → batch_stats.
+
+Name translation is per-architecture-family; the ResNet family mapping
+covers the reference's naming (``conv{2..5}x.{j}.conv{k}/bn{k}``,
+``projection.0/1``, ``linear`` — ref: ResNet/pytorch/models/resnet50.py).
+No torch import is needed unless reading a ``.pt`` file — conversion
+itself operates on numpy arrays.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+
+def strip_module_prefix(state_dict: Mapping) -> dict:
+    """Drop DataParallel's ``module.`` prefix (ref: README.md:85-93)."""
+    return {
+        (k[len("module."):] if k.startswith("module.") else k): v
+        for k, v in state_dict.items()
+    }
+
+
+def load_torch_checkpoint(path) -> dict:
+    """Read a ``.pt`` file → numpy state dict (handles the reference's
+    full-checkpoint dict and raw state dicts)."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=False)
+    if isinstance(obj, dict) and "model" in obj and isinstance(
+        obj["model"], dict
+    ):
+        obj = obj["model"]  # ref: train.py:417-428 schema
+    if hasattr(obj, "state_dict"):
+        obj = obj.state_dict()
+    return {
+        k: np.asarray(v.detach().cpu().numpy())
+        for k, v in strip_module_prefix(obj).items()
+    }
+
+
+def _to_numpy(v):
+    if hasattr(v, "detach"):
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def _set(tree: dict, path: tuple[str, ...], value) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+_BN_FIELDS = {
+    "weight": ("params", "scale", lambda v: v),
+    "bias": ("params", "bias", lambda v: v),
+    "running_mean": ("batch_stats", "mean", lambda v: v),
+    "running_var": ("batch_stats", "var", lambda v: v),
+}
+
+
+def _resnet_key(key: str):
+    """reference torch key -> (collection, flax path, transform) or None."""
+    conv_t = lambda v: v.transpose(2, 3, 1, 0)
+    if key == "conv1.weight":
+        return "params", ("stem", "conv", "kernel"), conv_t
+    m = re.fullmatch(r"bn1\.(\w+)", key)
+    if m and m.group(1) in _BN_FIELDS:
+        coll, leaf, f = _BN_FIELDS[m.group(1)]
+        return coll, ("stem", "bn", leaf), f
+    m = re.fullmatch(
+        r"conv(\d)x\.(\d+)\.(conv|bn)(\d)\.(\w+)", key
+    )
+    if m:
+        stage, block, kind, k, field = m.groups()
+        base = (f"stage{int(stage) - 1}_block{int(block) + 1}", f"conv{k}")
+        if kind == "conv":
+            return "params", base + ("conv", "kernel"), conv_t
+        if field in _BN_FIELDS:
+            coll, leaf, f = _BN_FIELDS[field]
+            return coll, base + ("bn", leaf), f
+        return None  # num_batches_tracked
+    m = re.fullmatch(
+        r"conv(\d)x\.(\d+)\.projection\.([01])\.(\w+)", key
+    )
+    if m:
+        stage, block, idx, field = m.groups()
+        base = (f"stage{int(stage) - 1}_block{int(block) + 1}", "proj")
+        if idx == "0":
+            return "params", base + ("conv", "kernel"), conv_t
+        if field in _BN_FIELDS:
+            coll, leaf, f = _BN_FIELDS[field]
+            return coll, base + ("bn", leaf), f
+        return None
+    if key == "linear.weight":
+        return "params", ("fc", "kernel"), lambda v: v.T
+    if key == "linear.bias":
+        return "params", ("fc", "bias"), lambda v: v
+    return None
+
+
+def torch_to_flax(
+    state_dict: Mapping, key_fn: Callable[[str], Any] = _resnet_key
+) -> dict:
+    """state dict -> {'params': ..., 'batch_stats': ...} (f32 numpy).
+
+    Unmapped keys raise so silent coverage gaps can't produce a model with
+    randomly-initialized leftovers.
+    """
+    out: dict[str, dict] = {"params": {}, "batch_stats": {}}
+    skipped = []
+    for key, value in strip_module_prefix(dict(state_dict)).items():
+        spec = key_fn(key)
+        if spec is None:
+            skipped.append(key)
+            continue
+        coll, path, transform = spec
+        _set(out[coll], path, transform(_to_numpy(value)).astype(np.float32))
+    hard_misses = [
+        k for k in skipped if not k.endswith("num_batches_tracked")
+    ]
+    if hard_misses:
+        raise KeyError(f"unmapped torch keys: {hard_misses[:10]}")
+    return out
+
+
+def resnet_torch_to_flax(state_dict: Mapping) -> dict:
+    """Reference ResNet-34/50/152 torch weights → Flax variables for
+    ``models.resnet`` (same mapping covers all three depths)."""
+    return torch_to_flax(state_dict, _resnet_key)

@@ -60,16 +60,23 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch):
-    """Place a host-local pytree of numpy arrays onto the mesh, batch-sharded.
+    """Place a per-process pytree of numpy arrays onto the mesh, batch-
+    sharded over the ``data`` axis.
 
-    Single-process equivalent of
-    ``jax.make_array_from_process_local_data``; the multi-host path goes
-    through :mod:`deepvision_tpu.data.device_put` which shards per-host
-    ``tf.data`` output (the reference's ``experimental_distribute_dataset``
-    analog — ref: YOLO/tensorflow/train.py:291-294).
+    Multi-process (multi-host) runs assemble a GLOBAL array from each
+    process's local shard via ``jax.make_array_from_process_local_data``
+    (the reference's ``experimental_distribute_dataset`` analog —
+    ref: YOLO/tensorflow/train.py:291-294); single-process runs take the
+    plain sharded ``device_put`` path. Same call either way — the Trainer
+    never branches. Re-exported as ``data.device_put.shard_by_process``.
     """
+    multi = jax.process_count() > 1
+
     def put(x):
         x = np.asarray(x)
-        return jax.device_put(x, data_sharding(mesh, x.ndim))
+        sharding = data_sharding(mesh, x.ndim)
+        if multi:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(put, batch)

@@ -1,44 +1,23 @@
 """Host batch → global device array placement (single- and multi-host).
 
-The reference's multi-device data path is
-``strategy.experimental_distribute_dataset`` (per-replica dataset sharding —
-ref: YOLO/tensorflow/train.py:291-294). TPU-native equivalent: each host's
-``tf.data`` pipeline reads a disjoint file shard
-(``data.imagenet.make_dataset(num_process=, process_index=)``) and the
-process-local numpy batch becomes one **global** ``jax.Array`` spanning the
-mesh via ``jax.make_array_from_process_local_data`` — batch-sharded over
-the ``data`` axis, with XLA collectives riding ICI within a slice and DCN
-across slices.
+The real implementation lives in :func:`deepvision_tpu.core.mesh.shard_batch`
+(one call for both the single-process sharded ``device_put`` path and the
+multi-host ``jax.make_array_from_process_local_data`` path); this module
+re-exports it under the data-layer name the pipelines document, plus the
+global-batch arithmetic helper.
 
-Single-process (one host, any number of local devices) degenerates to a
-plain sharded ``device_put`` — same call, no branching in user code.
+Each participating process feeds its own disjoint file shard
+(``data.imagenet.make_dataset(num_process=, process_index=)``) so that
+local_batch × process_count = global batch — the reference's
+``global_batch = per_replica × replicas`` arithmetic
+(ref: YOLO/tensorflow/train.py:282).
 """
 
 from __future__ import annotations
 
-import jax
-import numpy as np
+from deepvision_tpu.core.mesh import shard_batch as shard_by_process
 
-from deepvision_tpu.core.mesh import data_sharding
-
-
-def shard_by_process(mesh, batch):
-    """Per-process local batch pytree -> global batch-sharded jax.Arrays.
-
-    Every participating process must call this with its own local shard of
-    the global batch (local_batch = global_batch / process_count, the
-    reference's ``global_batch = per_replica × replicas`` arithmetic —
-    ref: YOLO/tensorflow/train.py:282).
-    """
-
-    def put(x):
-        x = np.asarray(x)
-        sharding = data_sharding(mesh, x.ndim)
-        if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        return jax.make_array_from_process_local_data(sharding, x)
-
-    return jax.tree_util.tree_map(put, batch)
+__all__ = ["shard_by_process", "global_batch_size"]
 
 
 def global_batch_size(mesh, per_device_batch: int) -> int:

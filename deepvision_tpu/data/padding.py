@@ -1,10 +1,11 @@
-"""Final-partial-batch padding with an evaluation mask.
+"""Batch iteration + final-partial-batch padding (one shared impl).
 
-One shared implementation for every eval pipeline (MNIST host arrays,
-ImageNet tf.data, detection/pose eval): the final partial batch is padded
-to the full compiled batch shape and a 0/1 ``mask`` row-validity vector is
-attached, so exact full-set evaluation needs only ONE compiled step shape
-(eval steps weight their per-sample sums by the mask).
+Every pipeline (MNIST host arrays, ImageNet/detection tf.data, synthetic
+sets) iterates epochs the same way: full batches for training, and for
+eval the final partial batch padded to the full compiled batch shape with
+a 0/1 ``mask`` row-validity vector — so exact full-set evaluation needs
+only ONE compiled step shape (eval steps weight their per-sample sums by
+the mask).
 """
 
 from __future__ import annotations
@@ -32,3 +33,38 @@ def pad_partial_batch(batch: dict, batch_size: int) -> dict:
     mask[n:] = 0.0
     out["mask"] = mask
     return out
+
+
+def iter_array_batches(arrays: dict, batch_size: int, *, rng=None,
+                       drop_remainder: bool = True):
+    """Epoch iterator over a dict of equal-length host arrays.
+
+    ``drop_remainder=False`` (the eval path) pads the final partial batch
+    via :func:`pad_partial_batch` and attaches a mask to EVERY batch.
+    """
+    n = len(next(iter(arrays.values())))
+    idx = np.arange(n)
+    if rng is not None:
+        rng.shuffle(idx)
+    end = n - n % batch_size if drop_remainder else n
+    for s in range(0, end, batch_size):
+        sel = idx[s : s + batch_size]
+        batch = {k: v[sel] for k, v in arrays.items()}
+        if not drop_remainder:
+            batch = pad_partial_batch(batch, batch_size)
+        yield batch
+
+
+def iter_tf_batches(ds, keys, *, limit: int | None = None,
+                    pad_to: int | None = None):
+    """Epoch iterator over a ``tf.data`` dataset yielding tuples, as dicts
+    keyed by ``keys``; ``pad_to`` pads+masks the final partial batch."""
+    for i, values in enumerate(ds.as_numpy_iterator()):
+        if limit is not None and i >= limit:
+            return
+        if not isinstance(values, tuple):
+            values = (values,)
+        batch = dict(zip(keys, values))
+        if pad_to is not None:
+            batch = pad_partial_batch(batch, pad_to)
+        yield batch

@@ -9,6 +9,7 @@ from deepvision_tpu.models import (  # noqa: F401
     resnet,
     shufflenet,
     vgg,
+    yolo,
 )
 
 __all__ = ["get_model", "list_models", "register"]

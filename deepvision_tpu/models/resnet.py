@@ -8,6 +8,14 @@ V1 variants re-express the PyTorch reference:
 
 Init parity: he-normal convs, BN gamma=1 beta=0 (ref: resnet50.py:84-93).
 
+Activation parity (for the checkpoint converter's layer-for-layer diff):
+- the reference puts the downsampling stride on the FIRST 1x1 of the
+  bottleneck (original-ResNet layout, ref: resnet50.py:100-108), NOT on the
+  3x3 as torchvision v1.5 does — matched here;
+- strided convs/pools use explicit symmetric (torch-style) padding, since
+  XLA "SAME" pads asymmetrically under stride 2 (e.g. (2,3) vs torch's
+  (3,3) on the 7x7 stem) and would shift border activations.
+
 Reference quirk kept behind ``always_project`` (default True for checkpoint-
 converter parity): the first block of EVERY group gets a projection shortcut
 even when stride=1 and channels match (ResNet-34 group 1), adding params vs
@@ -43,6 +51,7 @@ class BasicBlock(nn.Module):
     def __call__(self, x, train: bool = False):
         residual = x
         y = ConvBN(self.features, (3, 3), (self.strides,) * 2,
+                   padding=((1, 1), (1, 1)),
                    dtype=self.dtype, name="conv1")(x, train)
         y = ConvBN(self.features, (3, 3), act=None,
                    dtype=self.dtype, name="conv2")(y, train)
@@ -64,8 +73,11 @@ class BottleneckBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         residual = x
-        y = ConvBN(self.features, (1, 1), dtype=self.dtype, name="conv1")(x, train)
-        y = ConvBN(self.features, (3, 3), (self.strides,) * 2,
+        # stride on the 1x1 reduce — the reference's (original-paper)
+        # layout, ref: resnet50.py:100-108; torchvision v1.5 differs
+        y = ConvBN(self.features, (1, 1), (self.strides,) * 2,
+                   dtype=self.dtype, name="conv1")(x, train)
+        y = ConvBN(self.features, (3, 3), padding=((1, 1), (1, 1)),
                    dtype=self.dtype, name="conv2")(y, train)
         y = ConvBN(self.features * 4, (1, 1), act=None,
                    dtype=self.dtype, name="conv3")(y, train)
@@ -87,8 +99,9 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         x = ConvBN(self.num_filters, (7, 7), (2, 2),
+                   padding=((3, 3), (3, 3)),
                    dtype=self.dtype, name="stem")(x, train)
-        x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = layers.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
         for i, n_blocks in enumerate(self.stage_sizes):
             feats = self.num_filters * (2 ** i)
             for j in range(n_blocks):
@@ -137,7 +150,8 @@ class PreActBottleneck(nn.Module):
                          epsilon=1.001e-5, dtype=jnp.float32, name="bn1")(y)
         y = nn.relu(y)
         y = nn.Conv(self.features, (3, 3), strides=(self.strides,) * 2,
-                    padding="SAME", use_bias=False, kernel_init=he_normal,
+                    padding=((1, 1), (1, 1)), use_bias=False,
+                    kernel_init=he_normal,
                     dtype=self.dtype, name="conv2")(y)
         y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1.001e-5, dtype=jnp.float32, name="bn2")(y)
@@ -160,9 +174,12 @@ class ResNetV2(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME", use_bias=True,
+        # keras-applications pads explicitly (ZeroPadding2D 3 then VALID,
+        # pool pad 1) — matched for HDF5-import activation parity
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=((3, 3), (3, 3)),
+                    use_bias=True,
                     kernel_init=he_normal, dtype=self.dtype, name="stem")(x)
-        x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        x = layers.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
         n_stages = len(self.stage_sizes)
         for i, n_blocks in enumerate(self.stage_sizes):
             feats = 64 * (2 ** i)

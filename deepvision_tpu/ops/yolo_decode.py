@@ -1,0 +1,53 @@
+"""YOLO grid ↔ absolute box transforms (pure jnp, jit-able).
+
+Semantics parity with ref: YOLO/tensorflow/yolov3.py:238-349:
+- absolute: b_xy = (sigmoid(t_xy) + cell) / S, b_wh = exp(t_wh) * anchor,
+  sigmoid objectness/classes,
+- relative (inverse): t_xy = b_xy * S - cell, t_wh = log(b_wh / anchor)
+  with non-finite entries (empty cells) zeroed.
+
+Grids are (..., S, S, anchor, 5+C); cell coordinates are (x, y) with x the
+W axis — axis -3 of the grid indexes rows (y), matching the reference's
+meshgrid layout (ref: yolov3.py:263-291).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _cell_offsets(size: int):
+    """(S, S, 1, 2) float32 where [y, x, 0] = (x, y)."""
+    cx, cy = jnp.meshgrid(jnp.arange(size), jnp.arange(size))
+    return jnp.stack([cx, cy], axis=-1)[:, :, None, :].astype(jnp.float32)
+
+
+def decode_absolute(y_pred, anchors_wh, num_classes: int):
+    """Raw grid (B, S, S, 3, 5+C) -> (boxes_xywh, objectness, classes).
+
+    boxes are normalized to [0, 1] image coordinates; objectness (…, 1) and
+    classes (…, C) are sigmoid probabilities (ref: yolov3.py:238-326).
+    """
+    size = y_pred.shape[-4]
+    t_xy = y_pred[..., 0:2]
+    t_wh = y_pred[..., 2:4]
+    objectness = jax.nn.sigmoid(y_pred[..., 4:5])
+    classes = jax.nn.sigmoid(y_pred[..., 5:])
+    b_xy = (jax.nn.sigmoid(t_xy) + _cell_offsets(size)) / size
+    b_wh = jnp.exp(t_wh) * jnp.asarray(anchors_wh, y_pred.dtype)
+    return jnp.concatenate([b_xy, b_wh], axis=-1), objectness, classes
+
+
+def encode_relative(true_xywh, anchors_wh):
+    """Absolute grid targets (B, S, S, 3, 4) -> cell-relative (t_xy, t_wh).
+
+    Inverse of :func:`decode_absolute` for loss computation
+    (ref: yolov3.py:329-349). Cells without a box (wh=0) produce zeros.
+    """
+    size = true_xywh.shape[-4]
+    t_xy = true_xywh[..., 0:2] * size - _cell_offsets(size)
+    ratio = true_xywh[..., 2:4] / jnp.asarray(anchors_wh, true_xywh.dtype)
+    t_wh = jnp.log(jnp.maximum(ratio, 1e-12))
+    t_wh = jnp.where(ratio > 0, t_wh, 0.0)
+    return jnp.concatenate([t_xy, t_wh], axis=-1)

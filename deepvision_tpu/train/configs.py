@@ -151,6 +151,31 @@ TRAINING_CONFIG: dict[str, dict] = {
         "scheduler_params": {"step_size": 2, "gamma": 0.94},
         "total_epochs": 200,
     },
+    # Darknet-53 ImageNet pretraining for the YOLO backbone (paper config;
+    # the reference trains detection from scratch and has no pretrain path)
+    "darknet53": {
+        "batch_size": 128,
+        "input_size": 256,
+        "optimizer": "sgd",
+        "optimizer_params": {"lr": 0.1, "momentum": 0.9,
+                             "weight_decay": 5e-4},
+        "scheduler": "step",
+        "scheduler_params": {"step_size": 30, "gamma": 0.1},
+        "total_epochs": 120,
+    },
+    # ref: YOLO/tensorflow/train.py:13-29 — per-replica batch 16, Adam 0.01,
+    # /10 plateau on val loss (simulated ReduceLROnPlateau :56-68), 300 ep
+    "yolov3": {
+        "batch_size": 16,
+        "input_size": 416,
+        "num_classes": 20,  # VOC; 80 for COCO (ref: train.py:14)
+        "dataset": "detection",
+        "optimizer": "adam",
+        "optimizer_params": {"lr": 0.01},
+        "scheduler": "plateau",
+        "scheduler_params": {"factor": 0.1, "mode": "max", "patience": 10},
+        "total_epochs": 300,
+    },
 }
 
 

@@ -58,6 +58,70 @@ def classification_train_step(
     return new_state, metrics
 
 
+def yolo_train_step(state: TrainState, batch: dict, key: jax.Array):
+    """One detection step on {'image','boxes','label'}.
+
+    Ground-truth grid encoding runs INSIDE the compiled step
+    (ops.yolo_encode — the reference does it per-sample on the host with
+    TensorArray loops, ref: YOLO/tensorflow/preprocess.py:137-269); grids
+    never cross the host↔device boundary. ``boxes`` are (B, M, 4) xywh
+    normalized, padded with zeros; ``label`` is (B, M) int32, -1 padding.
+    """
+    from deepvision_tpu.losses.yolo import yolo_loss
+    from deepvision_tpu.ops.yolo_encode import encode_labels
+
+    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    size = images.shape[1]
+    grid_sizes = (size // 8, size // 16, size // 32)
+
+    def loss_fn(params):
+        preds, mutated = state.apply_fn(
+            {"params": params, "batch_stats": state.batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        num_classes = preds[0].shape[-1] - 5
+        y_true = encode_labels(
+            boxes, labels, num_classes, grid_sizes=grid_sizes
+        )
+        parts = yolo_loss(y_true, preds, num_classes,
+                          true_boxes_xywh=boxes)
+        loss = jnp.mean(parts["loss"])
+        return loss, (parts, mutated.get("batch_stats", state.batch_stats))
+
+    (loss, (parts, new_bs)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(state.params)
+    new_state = state.apply_gradients(grads, batch_stats=new_bs)
+    metrics = {k: jnp.mean(v) for k, v in parts.items()}
+    return new_state, metrics
+
+
+def yolo_eval_step(state: TrainState, batch: dict) -> dict:
+    """Mask-weighted val-loss sums (exact full-set aggregation)."""
+    from deepvision_tpu.losses.yolo import yolo_loss
+    from deepvision_tpu.ops.yolo_encode import encode_labels
+
+    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones(images.shape[0], jnp.float32)
+    size = images.shape[1]
+    grid_sizes = (size // 8, size // 16, size // 32)
+    variables: dict[str, Any] = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    preds = state.apply_fn(variables, images, train=False)
+    num_classes = preds[0].shape[-1] - 5
+    y_true = encode_labels(boxes, labels, num_classes, grid_sizes=grid_sizes)
+    parts = yolo_loss(y_true, preds, num_classes, true_boxes_xywh=boxes)
+    return {
+        "loss_sum": jnp.sum(parts["loss"] * mask),
+        "count": jnp.sum(mask),
+    }
+
+
 def classification_eval_step(state: TrainState, batch: dict) -> dict:
     """Count-weighted sums over one batch, for exact epoch aggregation.
 

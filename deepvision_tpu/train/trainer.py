@@ -157,10 +157,12 @@ class Trainer:
         if not totals:
             return {}
         n = totals.pop("count")
+        # generic: every step output is a count-weighted sum; "<k>_sum" and
+        # bare keys both become val_<k> means (works for classification's
+        # loss/top1/top5 and detection's loss alike)
         return {
-            "val_loss": totals["loss_sum"] / n,
-            "val_top1": totals["top1"] / n,
-            "val_top5": totals.get("top5", 0.0) / n,
+            f"val_{k[:-4] if k.endswith('_sum') else k}": v / n
+            for k, v in totals.items()
         }
 
     def fit(self, epochs: int | None = None) -> Loggers:
@@ -182,7 +184,12 @@ class Trainer:
             self.tb.flush()
             print(f"[epoch {epoch}] {_fmt(epoch_metrics)}", flush=True)
 
-            metric = val.get("val_top1", -tr["train_loss"])
+            # plateau metric: accuracy when available, else negated loss
+            # (the reference's detection trainers plateau on val loss,
+            # ref: YOLO/tensorflow/train.py:56-68)
+            metric = val.get(
+                "val_top1", -val.get("val_loss", tr["train_loss"])
+            )
             if self.plateau is not None:
                 scale = self.plateau.update(metric)
                 if scale != float(

@@ -1,0 +1,234 @@
+"""Checkpoint converter: torch → Flax logits parity, keras-h5 → Flax
+parity, DataParallel prefixes, full-checkpoint dicts, activation differ.
+
+The torch model here is an independent re-statement of the reference
+architecture (stride on the 1x1 reduce, projection on every first block —
+ref: ResNet/pytorch/models/resnet50.py) whose state-dict KEYS follow the
+reference naming (``conv{2..5}x.{j}``, ``projection.0/1``, ``linear``),
+which is the converter's input contract.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+
+from deepvision_tpu.convert import (  # noqa: E402
+    diff_activations,
+    keras_h5_to_flax,
+    load_torch_checkpoint,
+    resnet_name_map,
+    resnet_torch_to_flax,
+    strip_module_prefix,
+)
+from deepvision_tpu.models import get_model  # noqa: E402
+
+
+class _TorchBottleneck(tnn.Module):
+    def __init__(self, cin, mid, cout, stride=1, downsample=False):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, mid, 1, stride, bias=False)
+        self.bn1 = tnn.BatchNorm2d(mid)
+        self.conv2 = tnn.Conv2d(mid, mid, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(mid)
+        self.conv3 = tnn.Conv2d(mid, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.relu = tnn.ReLU()
+        self.downsample = downsample
+        if downsample:
+            self.projection = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout),
+            )
+
+    def forward(self, x):
+        identity = self.projection(x) if self.downsample else x
+        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.relu(self.bn2(self.conv2(x)))
+        x = self.bn3(self.conv3(x))
+        return self.relu(x + identity)
+
+
+class _TorchResNet50(tnn.Module):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU()
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+
+        def stage(n, cin, mid, cout, stride):
+            blocks = [_TorchBottleneck(cin, mid, cout, stride, True)]
+            blocks += [
+                _TorchBottleneck(cout, mid, cout) for _ in range(n - 1)
+            ]
+            return tnn.Sequential(*blocks)
+
+        self.conv2x = stage(3, 64, 64, 256, 1)
+        self.conv3x = stage(4, 256, 128, 512, 2)
+        self.conv4x = stage(6, 512, 256, 1024, 2)
+        self.conv5x = stage(3, 1024, 512, 2048, 2)
+        self.avgpool = tnn.AdaptiveAvgPool2d((1, 1))
+        self.linear = tnn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.conv5x(self.conv4x(self.conv3x(self.conv2x(x))))
+        x = self.avgpool(x).flatten(1)
+        return self.linear(x)
+
+
+@pytest.fixture(scope="module")
+def torch_model():
+    torch.manual_seed(0)
+    m = _TorchResNet50(num_classes=10)
+    # non-trivial BN stats so eval mode actually exercises running stats
+    for mod in m.modules():
+        if isinstance(mod, tnn.BatchNorm2d):
+            mod.running_mean.normal_(0, 0.05)
+            mod.running_var.uniform_(0.8, 1.2)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def fixture_image():
+    return np.random.default_rng(0).normal(
+        0, 1, size=(1, 64, 64, 3)
+    ).astype(np.float32)
+
+
+def _flax_variables(torch_model):
+    converted = resnet_torch_to_flax(torch_model.state_dict())
+    return {
+        "params": converted["params"],
+        "batch_stats": converted["batch_stats"],
+    }
+
+
+def test_converted_logits_match(torch_model, fixture_image):
+    model = get_model("resnet50", num_classes=10)
+    variables = _flax_variables(torch_model)
+    flax_logits = np.asarray(
+        model.apply(variables, fixture_image, train=False)
+    )
+    with torch.no_grad():
+        torch_logits = torch_model(
+            torch.from_numpy(fixture_image.transpose(0, 3, 1, 2))
+        ).numpy()
+    np.testing.assert_allclose(flax_logits, torch_logits, atol=1e-4)
+
+
+def test_converted_tree_matches_init(torch_model, fixture_image):
+    """The converted tree must be structurally identical to model.init's."""
+    model = get_model("resnet50", num_classes=10)
+    init_vars = model.init(jax.random.key(0), fixture_image, train=False)
+    converted = _flax_variables(torch_model)
+    for coll in ("params", "batch_stats"):
+        init_paths = {
+            "/".join(str(k) for k in p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(
+                init_vars[coll]
+            )[0]
+        }
+        conv_paths = {
+            "/".join(str(k) for k in p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(
+                converted[coll]
+            )[0]
+        }
+        assert init_paths == conv_paths
+
+
+def test_dataparallel_prefix_stripped(torch_model):
+    sd = {"module." + k: v for k, v in torch_model.state_dict().items()}
+    assert "conv1.weight" in strip_module_prefix(sd)
+    converted = resnet_torch_to_flax(sd)  # must not raise
+    assert "stem" in converted["params"]
+
+
+def test_full_checkpoint_dict_loaded(tmp_path, torch_model):
+    """The reference saves {'epoch','model','optimizer',...}
+    (ref: train.py:417-428) — loader must unwrap it."""
+    path = tmp_path / "ckpt.pt"
+    torch.save(
+        {
+            "epoch": 3,
+            "model": torch_model.state_dict(),
+            "optimizer": {},
+            "loggers": {"train_loss": {"epochs": [0], "value": [1.0]}},
+        },
+        path,
+    )
+    sd = load_torch_checkpoint(path)
+    assert "conv1.weight" in sd
+    converted = resnet_torch_to_flax(sd)
+    assert "stage4_block3" in converted["params"]
+
+
+def test_unmapped_keys_raise(torch_model):
+    sd = dict(torch_model.state_dict())
+    sd["mystery.weight"] = torch.zeros(1)
+    with pytest.raises(KeyError, match="mystery"):
+        resnet_torch_to_flax(sd)
+
+
+def test_diff_activations_per_layer(torch_model, fixture_image):
+    model = get_model("resnet50", num_classes=10)
+    variables = _flax_variables(torch_model)
+    report = diff_activations(
+        model, variables,
+        torch_model,
+        fixture_image,
+        resnet_name_map((3, 4, 6, 3)),
+    )
+    assert set(resnet_name_map((3, 4, 6, 3))) == set(report)
+    for name, err in report.items():
+        assert np.isfinite(err) and err < 1e-3, (name, err)
+
+
+def test_diff_activations_localizes_corruption(torch_model, fixture_image):
+    """Corrupt one converted layer; the diff must flag that stage onward
+    while earlier stages stay clean."""
+    model = get_model("resnet50", num_classes=10)
+    variables = _flax_variables(torch_model)
+    variables["params"]["stage3_block1"]["conv2"]["conv"]["kernel"] += 0.5
+    report = diff_activations(
+        model, variables, torch_model, fixture_image,
+        resnet_name_map((3, 4, 6, 3)),
+    )
+    assert report["stage2_block4"] < 1e-3  # before the corruption
+    assert report["stage3_block1"] > 1e-2  # at it
+
+
+def test_keras_h5_roundtrip(tmp_path, fixture_image):
+    """tf.keras.applications.ResNet50V2 (random init) → save_weights h5 →
+    converter → logits parity with models.resnet50v2."""
+    tf = pytest.importorskip("tensorflow")
+    h5py = pytest.importorskip("h5py")
+    keras_model = tf.keras.applications.ResNet50V2(
+        weights=None, input_shape=(64, 64, 3), classes=10,
+        classifier_activation=None,
+    )
+    # write the TF2.0-era layer-name-keyed HDF5 layout the reference's
+    # checkpoints use (Keras 3's native format drops layer names)
+    path = tmp_path / "weights.h5"
+    with h5py.File(path, "w") as f:
+        for layer in keras_model.layers:
+            values = layer.get_weights()
+            if not values:
+                continue
+            group = f.create_group(layer.name).create_group(layer.name)
+            for w, v in zip(layer.weights, values):
+                leaf = w.name.split("/")[-1]
+                group.create_dataset(leaf, data=v)
+    variables = keras_h5_to_flax(path)
+    model = get_model("resnet50v2", num_classes=10)
+    flax_logits = np.asarray(
+        model.apply(variables, fixture_image, train=False)
+    )
+    keras_logits = keras_model(fixture_image, training=False).numpy()
+    np.testing.assert_allclose(flax_logits, keras_logits, atol=1e-4)

@@ -1,0 +1,377 @@
+"""YOLO v3: model shapes, decode/encode inverse, loss fixtures, postprocess,
+pipeline invariants, and a synthetic train smoke.
+
+Loss fixtures are hand-computed against the reference semantics
+(ref: YOLO/tensorflow/yolov3.py:352-563).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepvision_tpu.losses.yolo import (
+    LAMBDA_COORD,
+    LAMBDA_NOOBJ,
+    yolo_loss,
+    yolo_scale_loss,
+)
+from deepvision_tpu.models import get_model
+from deepvision_tpu.ops.iou import broadcast_iou, xywh_to_corners
+from deepvision_tpu.ops.yolo_decode import decode_absolute, encode_relative
+from deepvision_tpu.ops.yolo_encode import ANCHORS_WH, encode_labels
+from deepvision_tpu.ops.yolo_postprocess import yolo_postprocess
+
+BCE_HALF = float(-np.log(0.5))  # BCE of p=0.5 vs any 0/1 target
+
+
+# ------------------------------------------------------------- model
+
+
+def test_yolov3_output_shapes():
+    model = get_model("yolov3", num_classes=4)
+    x = np.zeros((2, 128, 128, 3), np.float32)
+    vars_ = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(vars_, x, train=False)
+    assert [o.shape for o in out] == [
+        (2, 16, 16, 3, 9),
+        (2, 8, 8, 3, 9),
+        (2, 4, 4, 3, 9),
+    ]
+
+
+def test_darknet53_classifier_shape():
+    model = get_model("darknet53", num_classes=10)
+    x = np.zeros((1, 64, 64, 3), np.float32)
+    vars_ = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(vars_, x, train=False)
+    assert out.shape == (1, 10)
+
+
+# ----------------------------------------------------- decode / encode
+
+
+def test_decode_encode_inverse(rng):
+    s, c = 4, 3
+    anchors = ANCHORS_WH[6:9]
+    raw = rng.normal(0, 1, size=(2, s, s, 3, 5 + c)).astype(np.float32)
+    boxes, obj, classes = decode_absolute(raw, anchors, c)
+    assert boxes.shape == (2, s, s, 3, 4)
+    assert float(jnp.min(obj)) >= 0 and float(jnp.max(obj)) <= 1
+    rel = encode_relative(boxes, anchors)
+    # t_xy round-trips through the sigmoid; t_wh round-trips exactly
+    np.testing.assert_allclose(
+        np.asarray(rel[..., 0:2]),
+        np.asarray(jax.nn.sigmoid(raw[..., 0:2])),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(rel[..., 2:4]), raw[..., 2:4], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_decode_cell_offsets_xy_order():
+    # a box in grid row 0, column 2 must decode to x≈2.5/4, y≈0.5/4
+    s, c = 4, 1
+    raw = np.zeros((1, s, s, 3, 6), np.float32)
+    boxes, _, _ = decode_absolute(raw, ANCHORS_WH[0:3], c)
+    np.testing.assert_allclose(
+        np.asarray(boxes[0, 0, 2, 0, 0:2]), [2.5 / 4, 0.5 / 4], atol=1e-6
+    )
+
+
+# ----------------------------------------------------------- the loss
+
+
+def _fixture_truth(s=2, c=2):
+    """One true box exactly anchor-6-shaped, centered in cell (0,0)."""
+    aw, ah = ANCHORS_WH[6]
+    y_true = np.zeros((1, s, s, 3, 5 + c), np.float32)
+    y_true[0, 0, 0, 0, 0:4] = [0.25, 0.25, aw, ah]
+    y_true[0, 0, 0, 0, 4] = 1.0
+    y_true[0, 0, 0, 0, 5] = 1.0  # class 0
+    return y_true
+
+
+def _expected_noobj_cells(y_true, c=2):
+    """Count non-ignored noobj anchor slots for zero-logit predictions,
+    using the independently-tested IoU op."""
+    boxes, _, _ = decode_absolute(
+        np.zeros_like(y_true), ANCHORS_WH[6:9], c
+    )
+    pred_corners = np.asarray(xywh_to_corners(boxes)).reshape(-1, 4)
+    true_corners = np.asarray(
+        xywh_to_corners(y_true[0, 0, 0, 0, 0:4][None])
+    )
+    iou = np.asarray(broadcast_iou(pred_corners, true_corners))[:, 0]
+    not_ignored = iou < 0.5
+    obj_flat = y_true[0, ..., 4].reshape(-1) > 0
+    return int(np.sum(not_ignored & ~obj_flat))
+
+
+def test_loss_zero_logits_hand_computed():
+    c = 2
+    y_true = _fixture_truth(c=c)
+    y_pred = np.zeros_like(y_true)
+    parts = yolo_scale_loss(y_true, y_pred, ANCHORS_WH[6:9], c)
+    parts = {k: float(v[0]) for k, v in parts.items()}
+    # xy: true center is mid-cell (t=0.5) = sigmoid(0) -> exactly 0
+    assert parts["xy"] == pytest.approx(0.0, abs=1e-9)
+    # wh: true wh equals the anchor -> log ratio 0 = pred 0
+    assert parts["wh"] == pytest.approx(0.0, abs=1e-9)
+    # class: BCE(0.5) per class at the single object cell
+    assert parts["class"] == pytest.approx(c * BCE_HALF, rel=1e-5)
+    # obj: BCE(0.5) at the object cell + λ_noobj * BCE(0.5) per
+    # non-ignored noobj slot
+    n_noobj = _expected_noobj_cells(y_true, c)
+    expected_obj = BCE_HALF + LAMBDA_NOOBJ * n_noobj * BCE_HALF
+    assert parts["obj"] == pytest.approx(expected_obj, rel=1e-4)
+    assert parts["loss"] == pytest.approx(
+        parts["xy"] + parts["wh"] + parts["class"] + parts["obj"], rel=1e-6
+    )
+
+
+def test_loss_wh_component_hand_computed():
+    c = 2
+    y_true = _fixture_truth(c=c)
+    y_pred = np.zeros_like(y_true)
+    y_pred[0, 0, 0, 0, 2:4] = np.log(2.0)  # predict 2x anchor size
+    parts = yolo_scale_loss(y_true, y_pred, ANCHORS_WH[6:9], c)
+    aw, ah = ANCHORS_WH[6]
+    weight = 2.0 - aw * ah
+    expected = LAMBDA_COORD * weight * 2 * np.log(2.0) ** 2
+    assert float(parts["wh"][0]) == pytest.approx(expected, rel=1e-5)
+
+
+def test_loss_perfect_prediction_near_zero():
+    c = 2
+    y_true = _fixture_truth(c=c)
+    y_pred = np.zeros_like(y_true)
+    y_pred[..., 4] = -20.0  # obj -> ~0 everywhere
+    y_pred[0, 0, 0, 0, 0:2] = 0.0  # sigmoid(0)=0.5 = true t_xy
+    y_pred[0, 0, 0, 0, 2:4] = 0.0
+    y_pred[0, 0, 0, 0, 4] = 20.0  # obj -> ~1
+    y_pred[0, 0, 0, 0, 5] = 20.0  # class 0 -> ~1
+    y_pred[0, 0, 0, 0, 6] = -20.0
+    parts = yolo_scale_loss(y_true, y_pred, ANCHORS_WH[6:9], c)
+    assert float(parts["loss"][0]) < 1e-3
+
+
+def test_loss_ignore_mask_suppresses_noobj_penalty():
+    """A confident noobj prediction overlapping a true box (IoU>0.5) must
+    NOT be penalized when the true box is in the ignore set."""
+    c = 2
+    y_true = _fixture_truth(c=c)
+    y_pred = np.zeros_like(y_true)
+    # anchor 1 slot at the object cell predicts nearly the true box:
+    # same center; wh scaled from anchor 7 to anchor 6's size
+    y_pred[0, 0, 0, 1, 2:4] = np.log(ANCHORS_WH[6] / ANCHORS_WH[7])
+    y_pred[0, 0, 0, 1, 4] = 5.0  # confident objectness
+    with_mask = yolo_scale_loss(
+        y_true, y_pred, ANCHORS_WH[6:9], c,
+        true_boxes_xywh=y_true[..., 0:4].reshape(1, -1, 4),
+    )
+    # same prediction, but an empty true-box set -> penalty applies
+    without = yolo_scale_loss(
+        y_true, y_pred, ANCHORS_WH[6:9], c,
+        true_boxes_xywh=np.zeros((1, 4, 4), np.float32),
+    )
+    assert float(with_mask["obj"][0]) < float(without["obj"][0]) - 1.0
+
+
+def test_yolo_loss_three_scales_additive():
+    c = 3
+    boxes = np.zeros((2, 5, 4), np.float32)
+    labels = np.full((2, 5), -1, np.int32)
+    boxes[0, 0] = [0.5, 0.5, 0.3, 0.3]
+    labels[0, 0] = 1
+    boxes[1, 0] = [0.25, 0.75, 0.05, 0.05]
+    labels[1, 0] = 2
+    grids = encode_labels(boxes, labels, c, grid_sizes=(8, 4, 2))
+    preds = [
+        np.random.default_rng(i).normal(
+            0, 0.1, size=g.shape
+        ).astype(np.float32)
+        for i, g in enumerate(grids)
+    ]
+    total = yolo_loss(grids, preds, c, true_boxes_xywh=boxes)
+    by_scale = [
+        yolo_scale_loss(g, p, a, c, true_boxes_xywh=boxes)["loss"]
+        for g, p, a in zip(
+            grids, preds,
+            (ANCHORS_WH[0:3], ANCHORS_WH[3:6], ANCHORS_WH[6:9]),
+        )
+    ]
+    np.testing.assert_allclose(
+        np.asarray(total["loss"]),
+        np.asarray(sum(by_scale)),
+        rtol=1e-6,
+    )
+    assert np.all(np.isfinite(np.asarray(total["loss"])))
+
+
+# ------------------------------------------------------- postprocess
+
+
+def test_postprocess_recovers_planted_box():
+    s_grids, c = (8, 4, 2), 3
+    grids = [
+        np.full((1, s, s, 3, 5 + c), -10.0, np.float32) for s in s_grids
+    ]
+    # plant one confident box: medium grid, cell (1, 2), anchor 1
+    aw, ah = ANCHORS_WH[4]
+    grids[1][0, 1, 2, 1, 0:2] = 0.0  # center of the cell
+    grids[1][0, 1, 2, 1, 2:4] = 0.0  # wh = anchor
+    grids[1][0, 1, 2, 1, 4] = 10.0  # objectness
+    grids[1][0, 1, 2, 1, 5 + 2] = 10.0  # class 2
+    boxes, scores, classes, valid = yolo_postprocess(
+        grids, c, score_thresh=0.5
+    )
+    v = np.asarray(valid[0])
+    assert v.sum() == 1
+    got = np.asarray(boxes[0][v])[0]
+    cx, cy = 2.5 / 4, 1.5 / 4
+    np.testing.assert_allclose(
+        got, [cx - aw / 2, cy - ah / 2, cx + aw / 2, cy + ah / 2],
+        atol=1e-4,
+    )
+    assert int(np.asarray(classes[0][v])[0]) == 2
+    assert float(np.asarray(scores[0][v])[0]) > 0.99
+
+
+# ---------------------------------------------------------- pipeline
+
+
+def test_random_flip_mirrors_boxes():
+    import tensorflow as tf
+
+    from deepvision_tpu.data.detection import random_flip
+
+    img = np.arange(4 * 6 * 3, dtype=np.float32).reshape(4, 6, 3)
+    boxes = np.array([[0.1, 0.2, 0.4, 0.8]], np.float32)
+    flipped_any = unflipped_any = False
+    for seed in range(8):
+        tf.random.set_seed(seed)
+        out_img, out_boxes = random_flip(
+            tf.constant(img), tf.constant(boxes)
+        )
+        out_img, out_boxes = out_img.numpy(), out_boxes.numpy()
+        if np.allclose(out_img, img):
+            unflipped_any = True
+            np.testing.assert_allclose(out_boxes, boxes)
+        else:
+            flipped_any = True
+            np.testing.assert_allclose(out_img, img[:, ::-1])
+            np.testing.assert_allclose(
+                out_boxes, [[0.6, 0.2, 0.9, 0.8]], rtol=1e-6
+            )
+    assert flipped_any and unflipped_any
+
+
+def test_random_crop_preserves_boxes():
+    import tensorflow as tf
+
+    from deepvision_tpu.data.detection import random_crop
+
+    img = np.random.default_rng(0).uniform(
+        0, 255, (64, 48, 3)
+    ).astype(np.float32)
+    boxes = np.array(
+        [[0.3, 0.4, 0.6, 0.7], [0.5, 0.2, 0.7, 0.5]], np.float32
+    )
+    for seed in range(8):
+        tf.random.set_seed(seed)
+        out_img, out_boxes = random_crop(
+            tf.constant(img), tf.constant(boxes)
+        )
+        b = out_boxes.numpy()
+        assert np.all(b >= -1e-5) and np.all(b <= 1 + 1e-5)
+        assert np.all(b[:, 2] > b[:, 0]) and np.all(b[:, 3] > b[:, 1])
+        assert out_img.numpy().shape[0] <= 64
+
+
+def test_detection_dataset_end_to_end(tmp_path):
+    from PIL import Image
+
+    from deepvision_tpu.data.builders.detection import build_voc_tfrecords
+    from deepvision_tpu.data.detection import (
+        MAX_BOXES,
+        make_detection_dataset,
+    )
+
+    root = tmp_path / "voc"
+    (root / "ImageSets" / "Main").mkdir(parents=True)
+    (root / "Annotations").mkdir()
+    (root / "JPEGImages").mkdir()
+    names = []
+    for i in range(3):
+        name = f"{i:06d}"
+        names.append(name)
+        Image.fromarray(
+            np.full((60, 80, 3), 30 * i, np.uint8)
+        ).save(root / "JPEGImages" / f"{name}.jpg")
+        (root / "Annotations" / f"{name}.xml").write_text(
+            f"""<annotation><filename>{name}.jpg</filename>
+            <size><width>80</width><height>60</height></size>
+            <object><name>dog</name><bndbox><xmin>8</xmin><ymin>6</ymin>
+            <xmax>40</xmax><ymax>30</ymax></bndbox></object>
+            </annotation>"""
+        )
+    (root / "ImageSets" / "Main" / "train.txt").write_text(
+        "\n".join(names)
+    )
+    out = tmp_path / "records"
+    n = build_voc_tfrecords(root, out, "train", num_shards=1, num_workers=1)
+    assert n == 3
+
+    ds = make_detection_dataset(
+        str(out / "train-*"), batch_size=3, size=64, is_training=False
+    )
+    img, boxes, labels = next(iter(ds.as_numpy_iterator()))
+    assert img.shape == (3, 64, 64, 3)
+    assert boxes.shape == (3, MAX_BOXES, 4)
+    assert labels.shape == (3, MAX_BOXES)
+    assert img.min() >= -1.0 and img.max() <= 1.0
+    # dog = VOC class 11 (1-based 12); pipeline shifts to 0-based
+    assert labels[0, 0] == 11
+    assert np.all(labels[:, 1:] == -1)
+    # xywh of (8,6)-(40,30) in an 80x60 image
+    np.testing.assert_allclose(
+        boxes[0, 0], [0.3, 0.3, 0.4, 0.4], atol=1e-5
+    )
+
+
+# -------------------------------------------------------- train smoke
+
+
+def test_yolo_train_step_learns(mesh8):
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.data.detection import synthetic_detection
+    from deepvision_tpu.train.state import create_train_state
+    from deepvision_tpu.train.steps import yolo_eval_step, yolo_train_step
+
+    model = get_model("yolov3", num_classes=3)
+    imgs, boxes, labels = synthetic_detection(8, size=64, num_classes=3)
+    state = create_train_state(model, optax.adam(1e-3), imgs[:1])
+    step = compile_train_step(yolo_train_step, mesh8)
+    batch = {"image": imgs, "boxes": boxes, "label": labels}
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # eval step aggregates with a mask
+    part = yolo_eval_step(
+        state,
+        {
+            "image": imgs, "boxes": boxes, "label": labels,
+            "mask": np.concatenate(
+                [np.ones(6, np.float32), np.zeros(2, np.float32)]
+            ),
+        },
+    )
+    assert float(part["count"]) == 6
+    assert np.isfinite(float(part["loss_sum"]))

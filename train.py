@@ -35,6 +35,8 @@ def parse_args():
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--synthetic-size", type=int, default=2048,
                    help="synthetic dataset size when no --data-dir")
+    p.add_argument("--steps-per-epoch", type=int, default=None,
+                   help="train steps per epoch (detection datasets)")
     return p.parse_args()
 
 
@@ -54,10 +56,48 @@ def main():
     if args.batch_size:
         cfg["batch_size"] = args.batch_size
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
-    model = get_model(args.model, dtype=dtype)
+    model = get_model(args.model, dtype=dtype,
+                      num_classes=cfg["num_classes"])
 
     size, ch = cfg["input_size"], cfg["channels"]
-    if args.data_dir and cfg["dataset"] == "imagenet":
+    step_fns = {}
+    if cfg["dataset"] == "detection":
+        from deepvision_tpu.train.steps import yolo_eval_step, yolo_train_step
+
+        step_fns = {"train_step": yolo_train_step,
+                    "eval_step": yolo_eval_step}
+        if args.data_dir:
+            from deepvision_tpu.data.detection import make_detection_data
+
+            steps = args.steps_per_epoch or 2501 // cfg["batch_size"]  # VOC07
+            train_data, val_data, steps = make_detection_data(
+                args.data_dir, cfg["batch_size"], size,
+                steps_per_epoch=steps,
+            )
+        else:
+            from deepvision_tpu.data.detection import (
+                synthetic_batches,
+                synthetic_detection,
+            )
+
+            n = args.synthetic_size
+            size = min(size, 128)  # keep the synthetic smoke config small
+            imgs, boxes, labels = synthetic_detection(
+                n, size=size, num_classes=cfg["num_classes"]
+            )
+            split = max(cfg["batch_size"], int(n * 0.1))
+            rng = np.random.default_rng(0)
+            train_data = lambda e: synthetic_batches(
+                imgs[split:], boxes[split:], labels[split:],
+                cfg["batch_size"], rng=rng,
+            )
+            val_data = lambda: synthetic_batches(
+                imgs[:split], boxes[:split], labels[:split],
+                cfg["batch_size"], drop_remainder=False,
+            )
+            steps = (n - split) // cfg["batch_size"]
+        cfg["input_size"] = size
+    elif args.data_dir and cfg["dataset"] == "imagenet":
         from deepvision_tpu.data.imagenet import make_imagenet_data
 
         train_data, val_data, steps = make_imagenet_data(
@@ -102,7 +142,7 @@ def main():
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     trainer = Trainer(
         model, cfg, mesh, train_data, val_data,
-        workdir=args.workdir, steps_per_epoch=steps,
+        workdir=args.workdir, steps_per_epoch=steps, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
