@@ -1,0 +1,41 @@
+"""Pose heatmap losses.
+
+Capability parity with ref: Hourglass/tensorflow/train.py:65-76 — MSE
+between predicted and target heatmaps with foreground pixels weighted
+×(81+1), summed over every stack's intermediate-supervision output.
+The reference divides by the global batch size after a per-replica mean
+(MirroredStrategy loss scaling); under jit+NamedSharding a plain global
+mean has identical semantics, so no explicit scaling appears here.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+FOREGROUND_WEIGHT = 81.0  # ref: train.py:69
+
+
+def weighted_heatmap_mse(
+    targets: jnp.ndarray,
+    outputs: Sequence[jnp.ndarray] | jnp.ndarray,
+    *,
+    per_sample: bool = False,
+) -> jnp.ndarray:
+    """Sum over stacks of foreground-weighted MSE vs one shared target.
+
+    targets: (B, H, W, K); outputs: per-stack (B, H, W, K) predictions.
+    With ``per_sample`` the per-image loss (B,) is returned (for exact
+    masked validation aggregation), else the scalar mean.
+    """
+    if not isinstance(outputs, (tuple, list)):
+        outputs = (outputs,)
+    targets = targets.astype(jnp.float32)
+    weights = (targets > 0).astype(jnp.float32) * FOREGROUND_WEIGHT + 1.0
+    axes = (1, 2, 3)
+    total = 0.0
+    for out in outputs:
+        sq = jnp.square(targets - out.astype(jnp.float32)) * weights
+        total = total + jnp.mean(sq, axis=axes)
+    return total if per_sample else jnp.mean(total)

@@ -1,0 +1,63 @@
+"""Gaussian keypoint-heatmap targets as a pure, vectorized jnp op.
+
+Capability parity with ref: Hourglass/tensorflow/preprocess.py:91-173 —
+per-joint 2-D Gaussians (σ=1, truncated to a 7×7 patch; all-zero map for
+invisible or fully out-of-bounds joints). The reference builds each patch
+with nested Python ``tf.TensorArray`` scatter loops per joint on the host;
+here the whole (H, W, K) target is one broadcasted expression that runs
+inside the jitted train step, so targets never cross the host↔device
+boundary (same design as ops/yolo_encode).
+
+Note the reference's Gaussian peak is 12, not 1: its
+``generate_2d_guassian`` multiplies by a default ``scale=12``
+(preprocess.py:91,120) despite the in-code comment saying the center
+"should be 1". The paper (Newell et al. 2016, following Tompson et al.)
+uses peak 1. ``peak`` defaults to 1.0 here; pass 12.0 for bit-parity with
+the reference's targets.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gaussian_heatmaps(
+    kx: jnp.ndarray,
+    ky: jnp.ndarray,
+    visible: jnp.ndarray,
+    *,
+    height: int = 64,
+    width: int = 64,
+    sigma: float = 1.0,
+    peak: float = 1.0,
+) -> jnp.ndarray:
+    """(..., K) normalized keypoints -> (..., H, W, K) heatmaps.
+
+    kx, ky: float in [0, 1] (fractions of heatmap width/height);
+    visible: int/bool, 0 = occluded/absent -> all-zero map (ref
+    preprocess.py:109: "a ground truth heatmap of all zeros is provided").
+    Leading batch dimensions broadcast.
+    """
+    kx = jnp.asarray(kx, jnp.float32)
+    ky = jnp.asarray(ky, jnp.float32)
+    # Ref rounds to integer heatmap cells (preprocess.py:160-161); keep that
+    # so targets match (and stay symmetric around the drawn center).
+    x0 = jnp.round(kx * width)
+    y0 = jnp.round(ky * height)
+
+    xs = jnp.arange(width, dtype=jnp.float32)
+    ys = jnp.arange(height, dtype=jnp.float32)
+    # dx: (..., 1, W, K); dy: (..., H, 1, K)
+    dx = xs[:, None] - x0[..., None, :]
+    dy = ys[:, None] - y0[..., None, :]
+    d2 = dx[..., None, :, :] ** 2 + dy[..., :, None, :] ** 2
+    g = peak * jnp.exp(-d2 / (2.0 * sigma * sigma))
+
+    # Truncate to the (6σ+1)² patch — exact zeros outside, like the ref's
+    # patch scatter; a patch fully outside the map is then all zeros too.
+    radius = 3.0 * sigma
+    inside = (jnp.abs(dx[..., None, :, :]) <= radius) & (
+        jnp.abs(dy[..., :, None, :]) <= radius
+    )
+    vis = (jnp.asarray(visible) > 0)[..., None, None, :]
+    return jnp.where(inside & vis, g, 0.0).astype(jnp.float32)

@@ -176,6 +176,22 @@ TRAINING_CONFIG: dict[str, dict] = {
         "scheduler_params": {"factor": 0.1, "mode": "max", "patience": 10},
         "total_epochs": 300,
     },
+    # ref: Hourglass/tensorflow/train.py:30-44,229-240 — Adam 1e-4 (the
+    # paper quote says "rmsprop 2.5e-4" but the code uses Adam), batch 16,
+    # /10 plateau on val loss after max_patience=10 stale epochs (:46-58)
+    "hourglass104": {
+        "batch_size": 16,
+        "input_size": 256,
+        "num_heatmaps": 16,
+        "dataset": "pose",
+        "optimizer": "adam",
+        "optimizer_params": {"lr": 1e-4},
+        # mode "max" on the Trainer's negated val loss (the yolov3
+        # convention): lower loss -> higher metric -> improvement
+        "scheduler": "plateau",
+        "scheduler_params": {"factor": 0.1, "mode": "max", "patience": 10},
+        "total_epochs": 100,
+    },
 }
 
 

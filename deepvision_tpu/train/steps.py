@@ -147,3 +147,62 @@ def classification_eval_step(state: TrainState, batch: dict) -> dict:
         "count": jnp.sum(mask),
         **{k: jnp.sum(v * mask) for k, v in correct.items()},
     }
+
+
+def pose_train_step(state: TrainState, batch: dict, key: jax.Array):
+    """One pose step on {'image','kx','ky','v'}.
+
+    Gaussian heatmap targets are rasterized INSIDE the compiled step
+    (ops.heatmap — the reference does it per-joint on the host with
+    TensorArray loops, ref: Hourglass/tensorflow/preprocess.py:91-173);
+    loss is the stack-summed foreground-weighted MSE
+    (ref: Hourglass/tensorflow/train.py:65-76).
+    """
+    from deepvision_tpu.losses.pose import weighted_heatmap_mse
+    from deepvision_tpu.ops.heatmap import gaussian_heatmaps
+
+    images = batch["image"]
+    grid = images.shape[1] // 4  # stem downsamples 256² -> 64²
+    targets = gaussian_heatmaps(
+        batch["kx"], batch["ky"], batch["v"], height=grid, width=grid
+    )
+
+    def loss_fn(params):
+        outputs, mutated = state.apply_fn(
+            {"params": params, "batch_stats": state.batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = weighted_heatmap_mse(targets, outputs)
+        return loss, mutated.get("batch_stats", state.batch_stats)
+
+    (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params
+    )
+    new_state = state.apply_gradients(grads, batch_stats=new_bs)
+    return new_state, {"loss": loss}
+
+
+def pose_eval_step(state: TrainState, batch: dict) -> dict:
+    """Mask-weighted val-loss sums (exact full-set aggregation)."""
+    from deepvision_tpu.losses.pose import weighted_heatmap_mse
+    from deepvision_tpu.ops.heatmap import gaussian_heatmaps
+
+    images = batch["image"]
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones(images.shape[0], jnp.float32)
+    grid = images.shape[1] // 4
+    targets = gaussian_heatmaps(
+        batch["kx"], batch["ky"], batch["v"], height=grid, width=grid
+    )
+    variables: dict[str, Any] = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    outputs = state.apply_fn(variables, images, train=False)
+    losses = weighted_heatmap_mse(targets, outputs, per_sample=True)
+    return {
+        "loss_sum": jnp.sum(losses * mask),
+        "count": jnp.sum(mask),
+    }

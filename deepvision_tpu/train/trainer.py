@@ -111,7 +111,7 @@ class Trainer:
 
         for i, batch in enumerate(self.train_data(epoch)):
             self._key, sub = jax.random.split(self._key)
-            counts.append(len(batch["label"]))
+            counts.append(len(batch["image"]))
             self.state, metrics = self._train_step(
                 self.state, shard_batch(self.mesh, batch), sub
             )
@@ -137,13 +137,15 @@ class Trainer:
             for k in (fetched[0] if fetched else {})
         }
         n_chips = self.mesh.devices.size
-        return {
-            "train_loss": agg.get("loss", float("nan")),
-            "train_top1": agg.get("top1", float("nan")),
-            "examples_per_sec": n_images / dt,
-            "images_per_sec_per_chip": n_images / dt / n_chips,
-            "lr_scale": self.plateau.scale if self.plateau else 1.0,
-        }
+        out = {
+            f"train_{k}": v for k, v in agg.items()
+        }  # loss + whatever the step emits (top1/top5, YOLO loss parts…)
+        out.update(
+            examples_per_sec=n_images / dt,
+            images_per_sec_per_chip=n_images / dt / n_chips,
+            lr_scale=self.plateau.scale if self.plateau else 1.0,
+        )
+        return out
 
     def validate(self) -> dict:
         totals = None

@@ -1,0 +1,221 @@
+"""Hourglass-104 pose: heatmap fixtures vs the reference's patch-scatter
+semantics (ref: Hourglass/tensorflow/preprocess.py:91-173), weighted-MSE
+loss fixtures (ref: train.py:65-76), model shapes, pipeline invariants,
+and a synthetic train smoke.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepvision_tpu.losses.pose import FOREGROUND_WEIGHT, weighted_heatmap_mse
+from deepvision_tpu.models import get_model
+from deepvision_tpu.ops.heatmap import gaussian_heatmaps
+
+# ------------------------------------------------------------ heatmaps
+
+
+def _ref_heatmap(height, width, y0, x0, visible, sigma=1.0, peak=1.0):
+    """Independent numpy rendering of the reference's 7x7 patch scatter
+    (preprocess.py:91-155): exact zeros outside the patch."""
+    hm = np.zeros((height, width), np.float32)
+    if visible == 0:
+        return hm
+    r = int(3 * sigma)
+    for j in range(height):
+        for i in range(width):
+            if abs(i - x0) <= r and abs(j - y0) <= r:
+                hm[j, i] = peak * np.exp(
+                    -((i - x0) ** 2 + (j - y0) ** 2) / (2 * sigma**2)
+                )
+    return hm
+
+
+def test_heatmap_matches_reference_scatter():
+    h = w = 16
+    kx = np.array([5 / w, 0.0, 15.6 / w], np.float32)
+    ky = np.array([8 / h, 2 / h, 0.1 / h], np.float32)
+    v = np.array([1, 0, 1], np.int32)
+    got = np.asarray(gaussian_heatmaps(kx, ky, v, height=h, width=w))
+    assert got.shape == (h, w, 3)
+    for k in range(3):
+        want = _ref_heatmap(
+            h, w, round(ky[k] * h), round(kx[k] * w), v[k]
+        )
+        np.testing.assert_allclose(got[..., k], want, atol=1e-6)
+
+
+def test_heatmap_peak_and_truncation():
+    got = np.asarray(
+        gaussian_heatmaps(
+            np.array([0.5]), np.array([0.5]), np.array([1]),
+            height=16, width=16,
+        )
+    )[..., 0]
+    assert got[8, 8] == pytest.approx(1.0)  # peak at the rounded center
+    assert got[8, 12] == 0.0  # beyond 3σ: exact zero (patch truncation)
+    assert got[8, 11] > 0.0  # inside the patch
+
+
+def test_heatmap_invisible_and_out_of_bounds_are_zero():
+    # visibility 0 → zeros even with valid coords (ref: preprocess.py:109)
+    z = gaussian_heatmaps(np.array([0.5]), np.array([0.5]), np.array([0]),
+                          height=8, width=8)
+    assert float(jnp.sum(z)) == 0.0
+    # patch fully out of bounds → zeros (ref returns early)
+    z = gaussian_heatmaps(np.array([2.0]), np.array([0.5]), np.array([1]),
+                          height=8, width=8)
+    assert float(jnp.sum(z)) == 0.0
+
+
+def test_heatmap_batched_shape():
+    b, k, h, w = 3, 16, 64, 64
+    r = np.random.default_rng(0)
+    hm = gaussian_heatmaps(
+        r.uniform(size=(b, k)), r.uniform(size=(b, k)),
+        np.ones((b, k), np.int32), height=h, width=w,
+    )
+    assert hm.shape == (b, h, w, k)
+
+
+# -------------------------------------------------------------- loss
+
+
+def test_weighted_mse_fixture():
+    # one foreground pixel (target 1) + three background: hand-computed.
+    target = np.zeros((1, 2, 2, 1), np.float32)
+    target[0, 0, 0, 0] = 1.0
+    out = np.full((1, 2, 2, 1), 0.5, np.float32)
+    # fg: (1-0.5)^2 * 82 ; bg: 0.25 * 1 each → mean over 4 px
+    want = (0.25 * (FOREGROUND_WEIGHT + 1) + 3 * 0.25) / 4
+    got = float(weighted_heatmap_mse(target, [out]))
+    assert got == pytest.approx(want, rel=1e-6)
+    # two identical stacks double the loss (stack sum, ref train.py:66-76)
+    got2 = float(weighted_heatmap_mse(target, [out, out]))
+    assert got2 == pytest.approx(2 * want, rel=1e-6)
+
+
+def test_weighted_mse_per_sample_matches_mean():
+    r = np.random.default_rng(1)
+    t = r.uniform(0, 1, (4, 8, 8, 2)).astype(np.float32)
+    o = r.normal(0, 1, (4, 8, 8, 2)).astype(np.float32)
+    per = weighted_heatmap_mse(t, [o], per_sample=True)
+    assert per.shape == (4,)
+    assert float(jnp.mean(per)) == pytest.approx(
+        float(weighted_heatmap_mse(t, [o])), rel=1e-6
+    )
+
+
+# -------------------------------------------------------------- model
+
+
+def test_hourglass_output_shapes():
+    model = get_model("hourglass104", num_heatmaps=4)
+    x = np.zeros((2, 64, 64, 3), np.float32)
+    vars_ = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(vars_, x, train=False)
+    assert len(out) == 4  # one heatmap per stack
+    assert all(o.shape == (2, 16, 16, 4) for o in out)
+    assert all(o.dtype == jnp.float32 for o in out)
+
+
+def test_hourglass_stacks_differ():
+    """Intermediate supervision heads are distinct parameters — each stack
+    must produce a different prediction (guards against the ref's
+    shadowed-index bug class, hourglass104.py:136-157)."""
+    model = get_model("hourglass104", num_heatmaps=2)
+    x = np.random.default_rng(0).normal(size=(1, 64, 64, 3)).astype(
+        np.float32
+    )
+    vars_ = model.init(jax.random.key(1), x, train=False)
+    out = model.apply(vars_, x, train=False)
+    assert not np.allclose(np.asarray(out[0]), np.asarray(out[-1]))
+
+
+# ----------------------------------------------------------- pipeline
+
+
+def test_synthetic_pose_batches_masked_tail():
+    from deepvision_tpu.data.pose import synthetic_pose, synthetic_pose_batches
+
+    imgs, kx, ky, v = synthetic_pose(n=10, size=32)
+    got = list(
+        synthetic_pose_batches(imgs, kx, ky, v, 4, drop_remainder=False)
+    )
+    assert len(got) == 3
+    assert got[-1]["image"].shape[0] == 4
+    assert got[-1]["mask"].tolist() == [1.0, 1.0, 0.0, 0.0]
+
+
+def test_pose_tfrecord_roundtrip(tmp_path):
+    """Builder → pipeline: keypoints survive the record + ROI crop."""
+    tf = pytest.importorskip("tensorflow")
+    from deepvision_tpu.data.builders.pose import build_mpii_tfrecords
+    from deepvision_tpu.data.pose import make_pose_dataset
+
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir()
+    r = np.random.default_rng(0)
+    anns = []
+    for i in range(4):
+        arr = r.integers(0, 255, (80, 60, 3), np.uint8)
+        tf.io.write_file(
+            str(img_dir / f"im{i}.jpg"),
+            tf.io.encode_jpeg(tf.constant(arr)),
+        )
+        anns.append({
+            "image": f"im{i}.jpg",
+            "joints": [
+                {"id": j, "x": 10.0 + j, "y": 20.0 + j, "visible": 1}
+                for j in range(16)
+            ],
+            "center": [30.0, 40.0],
+            "scale": 0.5,
+        })
+    ann_file = tmp_path / "ann.json"
+    import json
+
+    ann_file.write_text(json.dumps(anns))
+    n = build_mpii_tfrecords(img_dir, ann_file, tmp_path, "train",
+                             num_shards=1, num_workers=1)
+    assert n == 4
+    ds = make_pose_dataset(str(tmp_path / "train-*"), 2, 64,
+                           is_training=False)
+    img, kx, ky, v = next(iter(ds.as_numpy_iterator()))
+    assert img.shape == (2, 64, 64, 3)
+    assert kx.shape == ky.shape == (2, 16)
+    assert v.shape == (2, 16) and v.dtype == np.int32
+    assert img.min() >= -1.0 and img.max() <= 1.0
+    # all keypoints visible → all inside the padded crop
+    assert np.all((kx >= 0) & (kx <= 1)) and np.all((ky >= 0) & (ky <= 1))
+
+
+# -------------------------------------------------------- train smoke
+
+
+def test_pose_train_step_learns(mesh8):
+    from deepvision_tpu.core import shard_batch
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.data.pose import synthetic_pose
+    from deepvision_tpu.train.state import create_train_state
+    from deepvision_tpu.train.steps import pose_train_step
+
+    # order-4 recursion needs the 16² stem output ⇒ ≥64² input
+    imgs, kx, ky, v = synthetic_pose(n=16, size=64, num_joints=4)
+    model = get_model("hourglass104", num_heatmaps=4)
+    tx = optax.adam(1e-3)
+    state = create_train_state(model, tx, imgs[:1])
+    step = compile_train_step(pose_train_step, mesh8)
+    batch = shard_batch(
+        mesh8, {"image": imgs, "kx": kx, "ky": ky, "v": v}
+    )
+    key = jax.random.key(0)
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.fold_in(key, i))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # memorizes one batch

@@ -56,12 +56,50 @@ def main():
     if args.batch_size:
         cfg["batch_size"] = args.batch_size
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
-    model = get_model(args.model, dtype=dtype,
-                      num_classes=cfg["num_classes"])
+    if cfg["dataset"] == "pose":
+        model = get_model(args.model, dtype=dtype,
+                          num_heatmaps=cfg["num_heatmaps"])
+    else:
+        model = get_model(args.model, dtype=dtype,
+                          num_classes=cfg["num_classes"])
 
     size, ch = cfg["input_size"], cfg["channels"]
     step_fns = {}
-    if cfg["dataset"] == "detection":
+    if cfg["dataset"] == "pose":
+        from deepvision_tpu.train.steps import pose_eval_step, pose_train_step
+
+        step_fns = {"train_step": pose_train_step,
+                    "eval_step": pose_eval_step}
+        if args.data_dir:
+            from deepvision_tpu.data.pose import make_pose_data
+
+            steps = args.steps_per_epoch or 22245 // cfg["batch_size"]  # MPII
+            train_data, val_data, steps = make_pose_data(
+                args.data_dir, cfg["batch_size"], size,
+                steps_per_epoch=steps,
+            )
+        else:
+            from deepvision_tpu.data.pose import (
+                synthetic_pose,
+                synthetic_pose_batches,
+            )
+
+            n = args.synthetic_size
+            size = min(size, 128)  # keep the synthetic smoke config small
+            imgs, kx, ky, v = synthetic_pose(n, size=size)
+            split = max(cfg["batch_size"], int(n * 0.1))
+            rng = np.random.default_rng(0)
+            train_data = lambda e: synthetic_pose_batches(
+                imgs[split:], kx[split:], ky[split:], v[split:],
+                cfg["batch_size"], rng=rng,
+            )
+            val_data = lambda: synthetic_pose_batches(
+                imgs[:split], kx[:split], ky[:split], v[:split],
+                cfg["batch_size"], drop_remainder=False,
+            )
+            steps = (n - split) // cfg["batch_size"]
+        cfg["input_size"] = size
+    elif cfg["dataset"] == "detection":
         from deepvision_tpu.train.steps import yolo_eval_step, yolo_train_step
 
         step_fns = {"train_step": yolo_train_step,
