@@ -3,6 +3,7 @@ from deepvision_tpu.models.registry import get_model, list_models, register
 # Imports for registration side effects.
 from deepvision_tpu.models import (  # noqa: F401
     alexnet,
+    centernet,
     hourglass,
     inception,
     lenet,

@@ -1,0 +1,189 @@
+"""CenterNet / ObjectsAsPoints detector (Flax, NHWC).
+
+Capability parity with ref: ObjectsAsPoints/tensorflow/model.py:17-179 —
+2-stack "large hourglass" (order-5 recursion with per-order filter/depth
+maps) and a 3-branch detection head per stack (class center heatmap,
+box wh, center offset). The reference left this component unfinished
+(trainer inert, ref train.py:35,248); this is the completed capability.
+
+Reference defects fixed rather than copied (SURVEY "known defects"):
+
+- ref model.py:119-121 — the ``low3`` residual loop's result is discarded
+  (the final block reads ``low2``). We apply the trailing blocks
+  sequentially per the CenterNet source the ref cites
+  (large_hourglass.py kp_module).
+- ref model.py:176 — ``intermediate = ResidualBlock(x, …)`` throws away
+  the computed 2-conv re-injection sum. We feed the sum through the
+  residual block, per the cited source (large_hourglass.py:220-225).
+
+Divergence for trainability: the class-heatmap output conv's bias is
+initialized to −2.19 (prior prob ≈ 0.1) per the CenterNet/CornerNet
+recipe — the reference never trained, so it has no working init to
+mirror; without it penalty-reduced focal loss starts unstable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepvision_tpu.models.layers import he_normal
+from deepvision_tpu.models.registry import register
+
+Dtype = Any
+
+# Per-order (filters at this order, filters one level down) and residual
+# depths — ref: model.py:17-32 (from CenterNet large_hourglass).
+ORDER_FILTERS = {5: (256, 256), 4: (256, 384), 3: (384, 384),
+                 2: (384, 384), 1: (384, 512)}
+ORDER_RESIDUAL = {5: (2, 2), 4: (2, 2), 3: (2, 2), 2: (2, 2), 1: (2, 4)}
+
+
+class ResidualBlock(nn.Module):
+    """Post-activation residual: 1x1/s → BN → ReLU → 3x3 → BN, + skip
+    (1x1+BN projection on channel/stride change), ReLU (ref: model.py:35-69).
+    """
+
+    features: int
+    strides: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f, d = self.features, self.dtype
+
+        def bn(x, name):
+            return nn.BatchNorm(use_running_average=not train,
+                                dtype=jnp.float32, name=name)(x)
+
+        identity = x
+        if x.shape[-1] != f or self.strides > 1:
+            identity = nn.Conv(f, (1, 1), strides=(self.strides,) * 2,
+                               use_bias=False, kernel_init=he_normal,
+                               dtype=d, name="proj")(x)
+            identity = bn(identity, "proj_bn")
+        y = nn.Conv(f, (1, 1), strides=(self.strides,) * 2, use_bias=False,
+                    kernel_init=he_normal, dtype=d, name="conv1")(x)
+        y = nn.relu(bn(y, "bn1"))
+        y = nn.Conv(f, (3, 3), use_bias=False, kernel_init=he_normal,
+                    dtype=d, name="conv2")(y)
+        y = bn(y, "bn2")
+        return nn.relu(identity + y)
+
+
+class LargeHourglass(nn.Module):
+    """Order-``order`` module with per-order widths (ref: model.py:94-127)."""
+
+    order: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        curr_f, next_f = ORDER_FILTERS[self.order]
+        curr_r, next_r = ORDER_RESIDUAL[self.order]
+
+        up = x
+        for i in range(curr_r):
+            up = ResidualBlock(curr_f, dtype=d, name=f"up{i}")(up, train)
+
+        low = ResidualBlock(next_f, strides=2, dtype=d,
+                            name="down")(x, train)
+        for i in range(curr_r - 1):
+            low = ResidualBlock(next_f, dtype=d,
+                                name=f"low1_{i}")(low, train)
+        if self.order > 1:
+            low = LargeHourglass(self.order - 1, dtype=d,
+                                 name=f"inner{self.order - 1}")(low, train)
+        else:
+            for i in range(next_r):
+                low = ResidualBlock(next_f, dtype=d,
+                                    name=f"bottom_{i}")(low, train)
+        # trailing blocks applied sequentially (ref defect at :119-121)
+        for i in range(curr_r - 1):
+            low = ResidualBlock(next_f, dtype=d,
+                                name=f"low3_{i}")(low, train)
+        low = ResidualBlock(curr_f, dtype=d, name="low3_out")(low, train)
+
+        b, h, w, c = low.shape
+        up2 = jax.image.resize(low, (b, 2 * h, 2 * w, c), method="nearest")
+        return up + up2
+
+
+class DetectionBranch(nn.Module):
+    """3x3(256)+ReLU → 3x3(out); no BN (ref: model.py:72-78)."""
+
+    out_features: int
+    bias_init_value: float = 0.0
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(256, (3, 3), use_bias=True, kernel_init=he_normal,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.relu(y)
+        return nn.Conv(
+            self.out_features, (3, 3), use_bias=True,
+            kernel_init=he_normal,
+            bias_init=nn.initializers.constant(self.bias_init_value),
+            dtype=jnp.float32, name="out",
+        )(y.astype(jnp.float32))
+
+
+class CenterNet(nn.Module):
+    """2-stack large hourglass; per stack returns (heatmap logits (B,H,W,C),
+    wh (B,H,W,2), offset (B,H,W,2)) at output stride 4."""
+
+    num_classes: int = 80
+    num_stacks: int = 2
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+
+        def bn(x, name):
+            return nn.BatchNorm(use_running_average=not train,
+                                dtype=jnp.float32, name=name)(x)
+
+        # Stem (ref: model.py:140-145): 7x7/2 128 → residual 256 /2.
+        x = nn.Conv(128, (7, 7), strides=(2, 2), use_bias=False,
+                    kernel_init=he_normal, dtype=d, name="stem_conv")(x)
+        x = nn.relu(bn(x, "stem_bn"))
+        inter = ResidualBlock(256, strides=2, dtype=d,
+                              name="stem_res")(x, train)
+
+        outputs = []
+        for s in range(self.num_stacks):
+            y = LargeHourglass(5, dtype=d, name=f"hg{s}")(inter, train)
+            y = nn.Conv(256, (3, 3), use_bias=True, kernel_init=he_normal,
+                        dtype=d, name=f"post{s}_conv")(y)
+            y = nn.relu(bn(y, f"post{s}_bn"))
+
+            heat = DetectionBranch(self.num_classes, bias_init_value=-2.19,
+                                   dtype=d, name=f"head{s}_heat")(y)
+            wh = DetectionBranch(2, dtype=d, name=f"head{s}_wh")(y)
+            off = DetectionBranch(2, dtype=d, name=f"head{s}_off")(y)
+            outputs.append((heat, wh, off))
+
+            if s < self.num_stacks - 1:
+                x1 = nn.Conv(256, (1, 1), use_bias=True, dtype=d,
+                             name=f"remap_feat{s}")(y)
+                x1 = bn(x1, f"remap_feat{s}_bn")
+                x2 = nn.Conv(256, (1, 1), use_bias=True, dtype=d,
+                             name=f"remap_prev{s}")(inter)
+                x2 = bn(x2, f"remap_prev{s}_bn")
+                inter = nn.relu(x1 + x2)
+                # re-injection passes THROUGH the residual (ref defect :176)
+                inter = ResidualBlock(256, dtype=d,
+                                      name=f"remap_res{s}")(inter, train)
+        return tuple(outputs)
+
+
+@register("centernet")
+def centernet(num_classes: int = 80, dtype: Dtype = jnp.float32,
+              **kw) -> CenterNet:
+    return CenterNet(num_classes=num_classes, dtype=dtype, **kw)

@@ -1,0 +1,51 @@
+"""CenterNet peak decoding: heatmaps → top-K detections (pure jnp).
+
+The Objects-as-Points inference path the reference never reached: NMS is
+a 3×3 max-pool peak test on the class heatmaps (no IoU suppression
+needed), then top-K extraction with wh/offset gathered at the peak cells.
+Fixed shapes throughout — jit/TPU friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def decode_centernet(
+    heatmap_logits: jnp.ndarray,
+    wh: jnp.ndarray,
+    offset: jnp.ndarray,
+    *,
+    top_k: int = 100,
+) -> dict:
+    """(B, G, G, C) logits + (B, G, G, 2) wh/offset → top-K boxes.
+
+    Returns dict of boxes (B, K, 4) normalized xywh, scores (B, K),
+    classes (B, K) int32 — ordered by descending score.
+    """
+    B, G, _, C = heatmap_logits.shape
+    scores = jax.nn.sigmoid(heatmap_logits.astype(jnp.float32))
+    # 3x3 max-pool peak NMS: keep only local maxima.
+    pooled = jax.lax.reduce_window(
+        scores, -jnp.inf, jax.lax.max,
+        (1, 3, 3, 1), (1, 1, 1, 1), "SAME",
+    )
+    scores = jnp.where(scores == pooled, scores, 0.0)
+
+    flat = scores.reshape(B, -1)  # (B, G·G·C)
+    top_scores, idx = jax.lax.top_k(flat, top_k)
+    cls = (idx % C).astype(jnp.int32)
+    cell = idx // C
+    cy = cell // G
+    cx = cell % G
+
+    b = jnp.arange(B)[:, None]
+    off = offset[b, cy, cx]  # (B, K, 2) = (dx, dy)
+    sizes = wh[b, cy, cx]  # (B, K, 2) = (w, h) in cells
+    x = (cx.astype(jnp.float32) + off[..., 0]) / G
+    y = (cy.astype(jnp.float32) + off[..., 1]) / G
+    boxes = jnp.stack(
+        [x, y, sizes[..., 0] / G, sizes[..., 1] / G], axis=-1
+    )
+    return {"boxes": boxes, "scores": top_scores, "classes": cls}

@@ -1,0 +1,132 @@
+"""CenterNet ground-truth encoding as a pure, vectorized jnp op.
+
+The reference never finished this part (its heatmap generator returns
+early — ref: ObjectsAsPoints/tensorflow/preprocess.py:129-138); this is
+the completed capability, following the Objects-as-Points recipe the
+reference cites: class-wise center heatmaps splatted with size-adaptive
+Gaussians (CornerNet ``gaussian_radius``, min-overlap 0.7), box
+width/height and sub-cell center offsets regressed at center cells.
+
+TPU-first design: one fixed-shape ``.at[].max`` patch scatter per box
+(patches clipped to ``max_radius``), run inside the jitted train step —
+no host loops, no dynamic shapes (same design as ops/yolo_encode).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MIN_OVERLAP = 0.7  # CornerNet radius IoU target
+MAX_RADIUS = 6  # patch cap: (2·6+1)² scatter per box
+
+
+def gaussian_radius(height, width, min_overlap: float = MIN_OVERLAP):
+    """Largest corner displacement (in cells) keeping IoU ≥ min_overlap
+    (the CornerNet formula: min of the three quadratic cases)."""
+    a1 = 1.0
+    b1 = height + width
+    c1 = width * height * (1 - min_overlap) / (1 + min_overlap)
+    sq1 = jnp.sqrt(jnp.maximum(b1 * b1 - 4 * a1 * c1, 0.0))
+    r1 = (b1 - sq1) / (2 * a1)
+
+    a2 = 4.0
+    b2 = 2 * (height + width)
+    c2 = (1 - min_overlap) * width * height
+    sq2 = jnp.sqrt(jnp.maximum(b2 * b2 - 4 * a2 * c2, 0.0))
+    r2 = (b2 - sq2) / (2 * a2)
+
+    a3 = 4.0 * min_overlap
+    b3 = -2 * min_overlap * (height + width)
+    c3 = (min_overlap - 1) * width * height
+    sq3 = jnp.sqrt(jnp.maximum(b3 * b3 - 4 * a3 * c3, 0.0))
+    r3 = (b3 + sq3) / (2 * a3)
+    return jnp.minimum(jnp.minimum(r1, r2), r3)
+
+
+def encode_centernet(
+    boxes_xywh: jnp.ndarray,
+    labels: jnp.ndarray,
+    num_classes: int,
+    grid_size: int,
+    *,
+    max_radius: int = MAX_RADIUS,
+) -> dict:
+    """(B, M, 4) normalized xywh + (B, M) labels (−1 pad) → dense targets.
+
+    Returns dict of
+      heatmap: (B, G, G, C) Gaussian class heatmaps (peak 1, max-combined),
+      wh:      (B, G, G, 2) box sizes in cells at center cells,
+      offset:  (B, G, G, 2) sub-cell center offsets in [0, 1),
+      mask:    (B, G, G) 1.0 at object centers.
+    """
+    B, M = labels.shape
+    G = grid_size
+    valid = labels >= 0  # (B, M)
+    cls = jnp.clip(labels, 0, num_classes - 1)
+
+    cx = boxes_xywh[..., 0] * G
+    cy = boxes_xywh[..., 1] * G
+    w = boxes_xywh[..., 2] * G
+    h = boxes_xywh[..., 3] * G
+    ix = jnp.clip(cx.astype(jnp.int32), 0, G - 1)  # (B, M)
+    iy = jnp.clip(cy.astype(jnp.int32), 0, G - 1)
+
+    radius = jnp.maximum(gaussian_radius(h, w), 0.0)
+    sigma = jnp.maximum((2 * radius + 1) / 6.0, 1e-3)  # CornerNet diameter/6
+
+    # Patch scatter: K×K window around each center, max-combined.
+    K = 2 * max_radius + 1
+    d = jnp.arange(K) - max_radius  # (K,)
+    px = ix[..., None, None] + d[None, None, :, None]  # (B, M, K, 1)→x
+    py = iy[..., None, None] + d[None, None, None, :]  # (B, M, 1, K)→y
+    px = jnp.broadcast_to(px, (B, M, K, K))
+    py = jnp.broadcast_to(py, (B, M, K, K))
+    # Gaussians are centered on the integer center cell, as in the
+    # canonical draw_umich_gaussian.
+    fx = ix.astype(jnp.float32)[..., None, None]
+    fy = iy.astype(jnp.float32)[..., None, None]
+    d2 = (px - fx) ** 2 + (py - fy) ** 2
+    g = jnp.exp(-d2 / (2.0 * sigma[..., None, None] ** 2))
+    # zero out both padding boxes and cells beyond this box's own radius
+    # (CornerNet draws only within the computed radius)
+    rint = jnp.minimum(jnp.ceil(radius), float(max_radius))
+    within = (jnp.abs(px - ix[..., None, None]) <= rint[..., None, None]) & (
+        jnp.abs(py - iy[..., None, None]) <= rint[..., None, None]
+    )
+    g = jnp.where(within & valid[..., None, None], g, 0.0)
+
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(B)[:, None, None, None], (B, M, K, K)
+    )
+    cls_idx = jnp.broadcast_to(cls[..., None, None], (B, M, K, K))
+    heatmap = jnp.zeros((B, G, G, num_classes), jnp.float32)
+    heatmap = heatmap.at[
+        batch_idx.reshape(-1),
+        py.reshape(-1).clip(0, G - 1),
+        px.reshape(-1).clip(0, G - 1),
+        cls_idx.reshape(-1),
+    ].max(
+        # clip-to-edge would smear out-of-bounds patch cells onto border
+        # pixels; zero them instead (max with 0 is a no-op).
+        jnp.where(
+            (py >= 0) & (py < G) & (px >= 0) & (px < G), g, 0.0
+        ).reshape(-1)
+    )
+
+    # Center-cell regression targets (last-writer-wins on collisions, the
+    # same semantics as a host-side scatter). Padding boxes scatter to an
+    # out-of-bounds row and are DROPPED — they must not clobber cell (0,0).
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, M)).reshape(-1)
+    sy = jnp.where(valid, iy, G).reshape(-1)
+    sx = ix.reshape(-1)
+    wh = jnp.zeros((B, G, G, 2), jnp.float32)
+    wh = wh.at[b_idx, sy, sx, :].set(
+        jnp.stack([w, h], -1).reshape(-1, 2), mode="drop"
+    )
+    offset = jnp.zeros((B, G, G, 2), jnp.float32)
+    offset = offset.at[b_idx, sy, sx, :].set(
+        jnp.stack([cx - ix, cy - iy], -1).reshape(-1, 2), mode="drop"
+    )
+    mask = jnp.zeros((B, G, G), jnp.float32)
+    mask = mask.at[b_idx, sy, sx].set(1.0, mode="drop")
+    return {"heatmap": heatmap, "wh": wh, "offset": offset, "mask": mask}

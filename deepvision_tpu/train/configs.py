@@ -176,6 +176,23 @@ TRAINING_CONFIG: dict[str, dict] = {
         "scheduler_params": {"factor": 0.1, "mode": "max", "patience": 10},
         "total_epochs": 300,
     },
+    # ref: ObjectsAsPoints/tensorflow/train.py:24-57,205-216 — Adam,
+    # per-replica batch 16, /10 plateau after 10 stale epochs. The ref's
+    # 0.01 default was never trained (loss list empty, run commented out);
+    # we deliberately use 1e-3: 0.01 destabilizes penalty-reduced focal
+    # loss (the paper itself trains hourglass CenterNet at 2.5e-4).
+    "centernet": {
+        "batch_size": 16,
+        "input_size": 256,
+        "num_classes": 80,  # MSCOCO (ref model.py:131)
+        "dataset": "detection",
+        "steps": "centernet",
+        "optimizer": "adam",
+        "optimizer_params": {"lr": 1e-3},
+        "scheduler": "plateau",
+        "scheduler_params": {"factor": 0.1, "mode": "max", "patience": 10},
+        "total_epochs": 100,
+    },
     # ref: Hourglass/tensorflow/train.py:30-44,229-240 — Adam 1e-4 (the
     # paper quote says "rmsprop 2.5e-4" but the code uses Adam), batch 16,
     # /10 plateau on val loss after max_patience=10 stale epochs (:46-58)

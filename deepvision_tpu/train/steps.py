@@ -206,3 +206,59 @@ def pose_eval_step(state: TrainState, batch: dict) -> dict:
         "loss_sum": jnp.sum(losses * mask),
         "count": jnp.sum(mask),
     }
+
+
+def centernet_train_step(state: TrainState, batch: dict, key: jax.Array):
+    """One CenterNet step on the detection batch format
+    {'image','boxes','label'} (shared with YOLO); targets encoded in-step
+    (ops.centernet_encode), loss = focal + L1s over both stacks
+    (losses.centernet — the capability the reference left unfinished,
+    ref: ObjectsAsPoints/tensorflow/train.py:35,248).
+    """
+    from deepvision_tpu.losses.centernet import centernet_loss
+    from deepvision_tpu.ops.centernet_encode import encode_centernet
+
+    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    grid = images.shape[1] // 4  # output stride 4
+
+    def loss_fn(params):
+        outputs, mutated = state.apply_fn(
+            {"params": params, "batch_stats": state.batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        num_classes = outputs[0][0].shape[-1]
+        targets = encode_centernet(boxes, labels, num_classes, grid)
+        parts = centernet_loss(targets, outputs)
+        return parts["loss"], (parts, mutated.get("batch_stats",
+                                                  state.batch_stats))
+
+    (loss, (parts, new_bs)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True
+    )(state.params)
+    new_state = state.apply_gradients(grads, batch_stats=new_bs)
+    return new_state, parts
+
+
+def centernet_eval_step(state: TrainState, batch: dict) -> dict:
+    """Mask-weighted val-loss sums (exact full-set aggregation)."""
+    from deepvision_tpu.losses.centernet import centernet_loss
+    from deepvision_tpu.ops.centernet_encode import encode_centernet
+
+    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones(images.shape[0], jnp.float32)
+    grid = images.shape[1] // 4
+    variables: dict[str, Any] = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    outputs = state.apply_fn(variables, images, train=False)
+    num_classes = outputs[0][0].shape[-1]
+    targets = encode_centernet(boxes, labels, num_classes, grid)
+    parts = centernet_loss(targets, outputs, per_sample=True)
+    return {
+        "loss_sum": jnp.sum(parts["loss"] * mask),
+        "count": jnp.sum(mask),
+    }

@@ -100,10 +100,18 @@ def main():
             steps = (n - split) // cfg["batch_size"]
         cfg["input_size"] = size
     elif cfg["dataset"] == "detection":
-        from deepvision_tpu.train.steps import yolo_eval_step, yolo_train_step
+        if cfg.get("steps") == "centernet":
+            from deepvision_tpu.train.steps import (
+                centernet_eval_step as det_eval,
+                centernet_train_step as det_train,
+            )
+        else:
+            from deepvision_tpu.train.steps import (
+                yolo_eval_step as det_eval,
+                yolo_train_step as det_train,
+            )
 
-        step_fns = {"train_step": yolo_train_step,
-                    "eval_step": yolo_eval_step}
+        step_fns = {"train_step": det_train, "eval_step": det_eval}
         if args.data_dir:
             from deepvision_tpu.data.detection import make_detection_data
 
