@@ -1,0 +1,108 @@
+"""GAN input pipelines.
+
+- CycleGAN unpaired A/B stream: image-only TFRecords (our builders'
+  schema, data/builders/gan.py) → flip / resize-286 / random-crop-256 /
+  [-1, 1], A and B zipped per step — behavior parity with
+  ref: CycleGAN/tensorflow/train.py:85-118.
+- DCGAN uses the MNIST loaders (data/mnist.py) normalized to [-1, 1]
+  (ref: DCGAN/tensorflow/main.py:24-29).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from deepvision_tpu.data.padding import iter_tf_batches
+
+
+def _tf():
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    return tf
+
+
+def _parse_and_augment(size: int, is_training: bool):
+    tf = _tf()
+
+    def prep(serialized):
+        feats = tf.io.parse_single_example(
+            serialized,
+            {"image/encoded": tf.io.FixedLenFeature([], tf.string)},
+        )
+        image = tf.io.decode_jpeg(feats["image/encoded"], channels=3)
+        if is_training:
+            image = tf.image.random_flip_left_right(image)
+            image = tf.image.resize(
+                tf.cast(image, tf.float32), [size + 30, size + 30]
+            )
+            image = tf.image.random_crop(image, [size, size, 3])
+        else:
+            image = tf.image.resize(tf.cast(image, tf.float32),
+                                    [size, size])
+        return image / 127.5 - 1.0
+
+    return prep
+
+
+def make_cyclegan_dataset(
+    pattern_a: str,
+    pattern_b: str,
+    batch_size: int,
+    size: int = 256,
+    *,
+    is_training: bool = True,
+    shuffle_buffer: int = 1000,
+):
+    """Unpaired zip of the two domains; the shorter domain repeats so one
+    epoch covers the longer one (the ref zips raw, truncating to the
+    shorter — we keep the standard unpaired semantics and document)."""
+    tf = _tf()
+    prep = _parse_and_augment(size, is_training)
+
+    def one(pattern):
+        files = tf.data.Dataset.list_files(pattern, shuffle=is_training,
+                                           seed=0)
+        ds = tf.data.TFRecordDataset(
+            files, num_parallel_reads=tf.data.AUTOTUNE
+        )
+        if is_training:
+            ds = ds.shuffle(shuffle_buffer).repeat()
+        return ds.map(prep, num_parallel_calls=tf.data.AUTOTUNE)
+
+    ds = tf.data.Dataset.zip((one(pattern_a), one(pattern_b)))
+    ds = ds.batch(batch_size, drop_remainder=True)
+    return ds.prefetch(tf.data.AUTOTUNE)
+
+
+def make_cyclegan_data(
+    data_dir: str, batch_size: int, size: int = 256,
+    *, steps_per_epoch: int,
+):
+    """-> train_data(epoch) iterator of {'a','b'} batches."""
+    d = Path(data_dir)
+
+    def train_data(epoch: int):
+        ds = make_cyclegan_dataset(
+            str(d / "trainA-*"), str(d / "trainB-*"), batch_size, size
+        )
+        return iter_tf_batches(ds, ("a", "b"), limit=steps_per_epoch)
+
+    return train_data
+
+
+def synthetic_unpaired(n: int = 64, size: int = 64, seed: int = 0):
+    """Hermetic unpaired domains with a learnable mapping: domain A =
+    bright squares, domain B = the same distribution color-inverted."""
+    rng = np.random.default_rng(seed)
+    a = rng.normal(0.0, 0.05, (n, size, size, 3)).astype(np.float32)
+    b = rng.normal(0.0, 0.05, (n, size, size, 3)).astype(np.float32)
+    for i in range(n):
+        x1, y1 = rng.integers(4, size // 2, 2)
+        w = rng.integers(size // 4, size // 2)
+        a[i, y1:y1 + w, x1:x1 + w, :] += 0.9
+        x1, y1 = rng.integers(4, size // 2, 2)
+        b[i, y1:y1 + w, x1:x1 + w, :] -= 0.9
+    return np.clip(a, -1, 1), np.clip(b, -1, 1)

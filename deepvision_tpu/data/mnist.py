@@ -36,12 +36,18 @@ def _read_idx(path: str | Path) -> np.ndarray:
     return arr.reshape(dims)
 
 
-def load_mnist_idx(images_path, labels_path) -> tuple[np.ndarray, np.ndarray]:
-    """-> (images (N,32,32,1) float32 in [0,1], labels (N,) int32)."""
+def load_mnist_idx(images_path, labels_path,
+                   pad_to_32: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """-> (images (N,32,32,1) float32 in [0,1], labels (N,) int32).
+
+    ``pad_to_32=False`` keeps the native 28² (DCGAN geometry —
+    ref: DCGAN/tensorflow/main.py:24-26).
+    """
     images = _read_idx(images_path).astype(np.float32) / 255.0
     labels = _read_idx(labels_path).astype(np.int32)
-    # pad 28 -> 32 as the reference does (ref: LeNet/pytorch/data_load.py)
-    images = np.pad(images, ((0, 0), (2, 2), (2, 2)))
+    if pad_to_32:
+        # pad 28 -> 32 as the reference does (ref: LeNet/pytorch/data_load.py)
+        images = np.pad(images, ((0, 0), (2, 2), (2, 2)))
     return images[..., None], labels
 
 

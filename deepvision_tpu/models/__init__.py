@@ -4,6 +4,7 @@ from deepvision_tpu.models.registry import get_model, list_models, register
 from deepvision_tpu.models import (  # noqa: F401
     alexnet,
     centernet,
+    gan,
     hourglass,
     inception,
     lenet,

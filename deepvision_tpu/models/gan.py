@@ -1,0 +1,218 @@
+"""Generative models: DCGAN (MNIST) and CycleGAN G/D (Flax, NHWC).
+
+Capability parity with the reference:
+
+- DCGAN generator/discriminator — ref: DCGAN/tensorflow/models.py:8-65
+  (Dense→reshape→3 transposed convs w/ BN+LeakyReLU, tanh head; 2-conv
+  LeakyReLU+Dropout discriminator with a single logit).
+- CycleGAN 9-ResNet-block generator with reflection padding and a 70×70
+  PatchGAN discriminator — ref: CycleGAN/tensorflow/models.py:8-104.
+  The reference uses BatchNorm where the CycleGAN paper uses
+  InstanceNorm; we keep BatchNorm for behavior parity and expose
+  ``norm='instance'`` as the paper-accurate option.
+
+All are plain Flax modules; the two-network training dynamics live in
+train/gan.py (the reference embeds them in per-model scripts).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepvision_tpu.models.registry import register
+
+Dtype = Any
+
+
+def leaky(x, slope=0.3):
+    return nn.leaky_relu(x, negative_slope=slope)
+
+
+class DCGANGenerator(nn.Module):
+    """z (B, noise_dim) → (B, 28, 28, 1) in [-1, 1] (tanh)."""
+
+    noise_dim: int = 100
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        d = self.dtype
+
+        def bn(x, name):
+            return nn.BatchNorm(use_running_average=not train,
+                                dtype=jnp.float32, name=name)(x)
+
+        x = nn.Dense(7 * 7 * 256, use_bias=False, dtype=d, name="fc")(z)
+        x = leaky(bn(x, "bn0"))
+        x = x.reshape(x.shape[0], 7, 7, 256)
+        x = nn.ConvTranspose(128, (5, 5), strides=(1, 1), padding="SAME",
+                             use_bias=False, dtype=d, name="deconv1")(x)
+        x = leaky(bn(x, "bn1"))
+        x = nn.ConvTranspose(64, (5, 5), strides=(2, 2), padding="SAME",
+                             use_bias=False, dtype=d, name="deconv2")(x)
+        x = leaky(bn(x, "bn2"))
+        x = nn.ConvTranspose(1, (5, 5), strides=(2, 2), padding="SAME",
+                             use_bias=False, dtype=jnp.float32,
+                             name="deconv3")(x.astype(jnp.float32))
+        return jnp.tanh(x)
+
+
+class DCGANDiscriminator(nn.Module):
+    """(B, 28, 28, 1) → (B, 1) real/fake logit."""
+
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        x = nn.Conv(64, (5, 5), strides=(2, 2), padding="SAME", dtype=d,
+                    name="conv1")(x)
+        x = leaky(x)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = nn.Conv(128, (5, 5), strides=(2, 2), padding="SAME", dtype=d,
+                    name="conv2")(x)
+        x = leaky(x)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(1, dtype=jnp.float32,
+                        name="fc")(x.astype(jnp.float32))
+
+
+def reflect_pad(x, pad: int):
+    """NHWC reflection padding (ref ReflectionPad2d, models.py:8-14)."""
+    return jnp.pad(
+        x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+    )
+
+
+class _Norm(nn.Module):
+    """BatchNorm (ref parity) or InstanceNorm (paper)."""
+
+    kind: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.kind == "instance":
+            return nn.InstanceNorm(dtype=jnp.float32, name="norm")(x)
+        return nn.BatchNorm(use_running_average=not train,
+                            dtype=jnp.float32, name="norm")(x)
+
+
+class CycleGANResBlock(nn.Module):
+    """reflect-pad valid 3x3 conv ×2 with norm, residual add
+    (ref: models.py:17-38)."""
+
+    features: int = 256
+    norm: str = "batch"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+        y = reflect_pad(x, 1)
+        y = nn.Conv(self.features, (3, 3), padding="VALID", use_bias=False,
+                    dtype=d, name="conv1")(y)
+        y = nn.relu(_Norm(self.norm, name="norm1")(y, train))
+        y = reflect_pad(y, 1)
+        y = nn.Conv(self.features, (3, 3), padding="VALID", use_bias=False,
+                    dtype=d, name="conv2")(y)
+        y = _Norm(self.norm, name="norm2")(y, train)
+        return x + y
+
+
+class CycleGANGenerator(nn.Module):
+    """c7s1-64, d128, d256, R256×n, u128, u64, c7s1-3 + tanh
+    (ref: models.py:41-79)."""
+
+    n_blocks: int = 9
+    norm: str = "batch"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+
+        def norm(x, name):
+            return _Norm(self.norm, name=name)(x, train)
+
+        x = reflect_pad(x, 3)
+        x = nn.Conv(64, (7, 7), padding="VALID", use_bias=False, dtype=d,
+                    name="stem")(x)
+        x = nn.relu(norm(x, "stem_norm"))
+        x = nn.Conv(128, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d, name="down1")(x)
+        x = nn.relu(norm(x, "down1_norm"))
+        x = nn.Conv(256, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d, name="down2")(x)
+        x = nn.relu(norm(x, "down2_norm"))
+        for i in range(self.n_blocks):
+            x = CycleGANResBlock(256, self.norm, dtype=d,
+                                 name=f"res{i}")(x, train)
+        x = nn.ConvTranspose(128, (3, 3), strides=(2, 2), padding="SAME",
+                             use_bias=False, dtype=d, name="up1")(x)
+        x = nn.relu(norm(x, "up1_norm"))
+        x = nn.ConvTranspose(64, (3, 3), strides=(2, 2), padding="SAME",
+                             use_bias=False, dtype=d, name="up2")(x)
+        x = nn.relu(norm(x, "up2_norm"))
+        x = reflect_pad(x, 3)
+        x = nn.Conv(3, (7, 7), padding="VALID", dtype=jnp.float32,
+                    name="head")(x.astype(jnp.float32))
+        return jnp.tanh(x)
+
+
+class CycleGANDiscriminator(nn.Module):
+    """70×70 PatchGAN: C64-C128-C256-C512 + 1-ch patch logits
+    (ref: models.py:82-104)."""
+
+    norm: str = "batch"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = self.dtype
+
+        def norm(x, name):
+            return _Norm(self.norm, name=name)(x, train)
+
+        x = nn.Conv(64, (4, 4), strides=(2, 2), padding="SAME", dtype=d,
+                    name="conv1")(x)
+        x = leaky(x, 0.2)
+        x = nn.Conv(128, (4, 4), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d, name="conv2")(x)
+        x = leaky(norm(x, "norm2"), 0.2)
+        x = nn.Conv(256, (4, 4), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=d, name="conv3")(x)
+        x = leaky(norm(x, "norm3"), 0.2)
+        x = nn.Conv(512, (4, 4), strides=(1, 1), padding="SAME",
+                    use_bias=False, dtype=d, name="conv4")(x)
+        x = leaky(norm(x, "norm4"), 0.2)
+        return nn.Conv(1, (4, 4), strides=(1, 1), padding="SAME",
+                       dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32)
+        )
+
+
+@register("dcgan_generator")
+def dcgan_generator(dtype: Dtype = jnp.float32, **kw) -> DCGANGenerator:
+    return DCGANGenerator(dtype=dtype, **kw)
+
+
+@register("dcgan_discriminator")
+def dcgan_discriminator(dtype: Dtype = jnp.float32,
+                        **kw) -> DCGANDiscriminator:
+    return DCGANDiscriminator(dtype=dtype, **kw)
+
+
+@register("cyclegan_generator")
+def cyclegan_generator(dtype: Dtype = jnp.float32,
+                       **kw) -> CycleGANGenerator:
+    return CycleGANGenerator(dtype=dtype, **kw)
+
+
+@register("cyclegan_discriminator")
+def cyclegan_discriminator(dtype: Dtype = jnp.float32,
+                           **kw) -> CycleGANDiscriminator:
+    return CycleGANDiscriminator(dtype=dtype, **kw)

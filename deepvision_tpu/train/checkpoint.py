@@ -45,12 +45,7 @@ class CheckpointManager:
             "extra": extra or {},
             "best_metric": best_metric,
         }
-        payload = {
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "opt_state": state.opt_state,
-            "step": state.step,
-        }
+        payload = self._payload(state)
         self._mgr.save(
             epoch,
             args=ocp.args.Composite(
@@ -59,6 +54,20 @@ class CheckpointManager:
             ),
         )
         self._mgr.wait_until_finished()
+
+    @staticmethod
+    def _payload(state) -> dict:
+        """The checkpointed pytree. GAN states carry pools/etc. in an
+        ``extra_vars`` field mirrored here (train/gan.py)."""
+        payload = {
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+            "step": state.step,
+        }
+        if getattr(state, "extra_vars", None) is not None:
+            payload["extra_vars"] = state.extra_vars
+        return payload
 
     def latest_epoch(self) -> int | None:
         return self._mgr.latest_step()
@@ -69,12 +78,7 @@ class CheckpointManager:
             epoch = self._mgr.latest_step()
         if epoch is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        template = {
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "opt_state": state.opt_state,
-            "step": state.step,
-        }
+        template = self._payload(state)
         restored = self._mgr.restore(
             epoch,
             args=ocp.args.Composite(
@@ -83,12 +87,7 @@ class CheckpointManager:
             ),
         )
         payload, meta = restored["state"], dict(restored["meta"])
-        state = state.replace(
-            params=payload["params"],
-            batch_stats=payload["batch_stats"],
-            opt_state=payload["opt_state"],
-            step=payload["step"],
-        )
+        state = state.replace(**payload)
         if meta.get("loggers"):
             meta["loggers"] = Loggers.from_json(meta["loggers"])
         return state, meta

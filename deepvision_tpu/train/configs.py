@@ -176,6 +176,32 @@ TRAINING_CONFIG: dict[str, dict] = {
         "scheduler_params": {"factor": 0.1, "mode": "max", "patience": 10},
         "total_epochs": 300,
     },
+    # ref: DCGAN/tensorflow/main.py:13-17,31-32 — batch 256, two Adams
+    # 1e-4, 50 epochs, noise dim 100, checkpoint every 2 epochs keep 3
+    "dcgan": {
+        "batch_size": 256,
+        "input_size": 28,
+        "channels": 1,
+        "dataset": "gan_mnist",
+        "noise_dim": 100,
+        "optimizer": "adam",
+        "optimizer_params": {"lr": 1e-4},
+        "save_every": 2,
+        "total_epochs": 50,
+    },
+    # ref: CycleGAN/tensorflow/train.py:14-21,122-127 — batch 4 (CLI
+    # default), two Adams 2e-4 β1 0.5, LinearDecay to 0 over epochs
+    # 100..200, pool 50, λ_cycle 10, λ_id 5
+    "cyclegan": {
+        "batch_size": 4,
+        "input_size": 256,
+        "dataset": "gan_unpaired",
+        "optimizer": "adam",
+        "optimizer_params": {"lr": 2e-4, "beta1": 0.5},
+        "decay_epochs": 100,
+        "save_every": 1,
+        "total_epochs": 200,
+    },
     # ref: ObjectsAsPoints/tensorflow/train.py:24-57,205-216 — Adam,
     # per-replica batch 16, /10 plateau after 10 stale epochs. The ref's
     # 0.01 default was never trained (loss list empty, run commented out);

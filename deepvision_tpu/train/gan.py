@@ -1,0 +1,473 @@
+"""GAN training: two-optimizer states, DCGAN/CycleGAN steps, ImagePool.
+
+Re-expresses the reference's GAN trainers as pure compiled step functions:
+
+- DCGAN alternating G/D Adam updates computed from the SAME forward pass
+  (both losses share one fake batch and one discriminator dropout mask,
+  exactly the reference's two-tape step — ref: DCGAN/tensorflow/main.py:57-76).
+- CycleGAN two-phase step: generator phase (LSGAN + cycle + identity
+  losses over both generators, ref: CycleGAN/tensorflow/train.py:150-205)
+  then discriminator phase on POOLED fakes (ref: :207-255, :249-255).
+- ``ImagePool`` redesigned as an on-device functional ring buffer: the
+  reference's version mutates Python state and is documented eager-only
+  (ref: CycleGAN/tensorflow/utils.py:31-61); here the pool is part of the
+  train-state pytree and the query is a ``lax.scan``, so the whole step
+  (G update → pool query → D update) compiles into ONE XLA program.
+
+States mirror TrainState's field names (params/batch_stats/opt_state/step
+plus ``extra_vars`` for the pools) so the Orbax CheckpointManager handles
+them unchanged — the reference's `tf.train.Checkpoint` of both optimizers
+and nets (ref: DCGAN/tensorflow/main.py:34-40, CycleGAN/train.py:133-148).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+LAMBDA_CYCLE = 10.0  # ref: CycleGAN/tensorflow/train.py:16
+LAMBDA_ID = 5.0  # ref: train.py:17
+POOL_SIZE = 50  # ref: train.py:18
+
+
+@flax.struct.dataclass
+class GANState:
+    """Two-network train state. ``params``/``batch_stats`` are dicts keyed
+    by network role; ``opt_state`` holds one optax state per optimizer
+    ('generator' spans all generator nets, 'discriminator' all critics —
+    the reference's optimizer pairing, ref: CycleGAN/train.py:126-127)."""
+
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    extra_vars: Any
+    g_apply: Callable = flax.struct.field(pytree_node=False)
+    d_apply: Callable = flax.struct.field(pytree_node=False)
+    g_tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    d_tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    noise_dim: int = flax.struct.field(pytree_node=False, default=100)
+
+
+def _bce(logits, is_real: bool):
+    target = jnp.ones_like(logits) if is_real else jnp.zeros_like(logits)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, target))
+
+
+def _lsgan(pred, is_real: bool):
+    target = jnp.ones_like(pred) if is_real else jnp.zeros_like(pred)
+    return jnp.mean((pred - target) ** 2)
+
+
+def _l1(a, b):
+    return jnp.mean(jnp.abs(a - b))
+
+
+# --------------------------------------------------------------- DCGAN
+
+
+def create_dcgan_state(
+    generator, discriminator, *, noise_dim: int = 100,
+    lr: float = 1e-4, rng: int | jax.Array = 0,
+    sample_image_shape=(28, 28, 1),
+) -> GANState:
+    """Both Adams at 1e-4 (ref: DCGAN/tensorflow/main.py:31-32)."""
+    if isinstance(rng, int):
+        rng = jax.random.key(rng)
+    kg, kd = jax.random.split(rng)
+    z = jnp.zeros((1, noise_dim), jnp.float32)
+    gv = generator.init({"params": kg}, z, train=True)
+    x = jnp.zeros((1, *sample_image_shape), jnp.float32)
+    dv = discriminator.init({"params": kd, "dropout": kd}, x, train=True)
+    params = {"generator": gv["params"], "discriminator": dv["params"]}
+    stats = {
+        "generator": gv.get("batch_stats", {}),
+        "discriminator": dv.get("batch_stats", {}),
+    }
+    g_tx, d_tx = optax.adam(lr), optax.adam(lr)
+    return GANState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=stats,
+        opt_state={"generator": g_tx.init(params["generator"]),
+                   "discriminator": d_tx.init(params["discriminator"])},
+        extra_vars={},
+        g_apply=generator.apply,
+        d_apply=discriminator.apply,
+        g_tx=g_tx,
+        d_tx=d_tx,
+        noise_dim=noise_dim,
+    )
+
+
+def dcgan_train_step(state: GANState, batch: dict, key: jax.Array):
+    """One simultaneous G+D update on {'image'} — both gradients are taken
+    at the PRE-update parameters from one shared forward, like the
+    reference's two tapes over a single noise batch (ref: main.py:57-76).
+    """
+    real = batch["image"]
+    kz, kdrop_fake, kdrop_real = jax.random.split(key, 3)
+    z = jax.random.normal(kz, (real.shape[0], state.noise_dim))
+
+    def d_forward(d_params, images, drop_key, stats):
+        out, mut = state.d_apply(
+            {"params": d_params, "batch_stats": stats},
+            images, train=True, mutable=["batch_stats"],
+            rngs={"dropout": drop_key},
+        )
+        return out, mut.get("batch_stats", stats)
+
+    def g_loss_fn(g_params):
+        fake, g_mut = state.g_apply(
+            {"params": g_params, "batch_stats": state.batch_stats["generator"]},
+            z, train=True, mutable=["batch_stats"],
+        )
+        fake_logits, _ = d_forward(
+            state.params["discriminator"], fake, kdrop_fake,
+            state.batch_stats["discriminator"],
+        )
+        return _bce(fake_logits, True), (
+            g_mut.get("batch_stats", state.batch_stats["generator"]), fake
+        )
+
+    (g_loss, (g_stats, fake)), g_grads = jax.value_and_grad(
+        g_loss_fn, has_aux=True
+    )(state.params["generator"])
+
+    def d_loss_fn(d_params):
+        real_logits, d_stats = d_forward(
+            d_params, real, kdrop_real, state.batch_stats["discriminator"]
+        )
+        fake_logits, d_stats = d_forward(
+            d_params, jax.lax.stop_gradient(fake), kdrop_fake, d_stats
+        )
+        loss = _bce(real_logits, True) + _bce(fake_logits, False)
+        return loss, d_stats
+
+    (d_loss, d_stats), d_grads = jax.value_and_grad(
+        d_loss_fn, has_aux=True
+    )(state.params["discriminator"])
+
+    g_up, g_opt = state.g_tx.update(
+        g_grads, state.opt_state["generator"], state.params["generator"]
+    )
+    d_up, d_opt = state.d_tx.update(
+        d_grads, state.opt_state["discriminator"],
+        state.params["discriminator"],
+    )
+    new_state = state.replace(
+        step=state.step + 1,
+        params={
+            "generator": optax.apply_updates(state.params["generator"], g_up),
+            "discriminator": optax.apply_updates(
+                state.params["discriminator"], d_up
+            ),
+        },
+        batch_stats={"generator": g_stats, "discriminator": d_stats},
+        opt_state={"generator": g_opt, "discriminator": d_opt},
+    )
+    return new_state, {"g_loss": g_loss, "d_loss": d_loss}
+
+
+def dcgan_sample(state: GANState, key: jax.Array, n: int = 16):
+    """Sample n images in eval mode (ref: DCGAN/tensorflow/inference.py:26-29)."""
+    z = jax.random.normal(key, (n, state.noise_dim))
+    return state.g_apply(
+        {"params": state.params["generator"],
+         "batch_stats": state.batch_stats["generator"]},
+        z, train=False,
+    )
+
+
+# ----------------------------------------------------------- ImagePool
+
+
+def create_pool(size: int, image_shape, dtype=jnp.float32) -> dict:
+    return {
+        "images": jnp.zeros((size, *image_shape), dtype),
+        "count": jnp.zeros((), jnp.int32),
+    }
+
+
+def pool_query(pool: dict, images: jnp.ndarray, key: jax.Array):
+    """Historical-fake buffer query (ref semantics, utils.py:38-61):
+    per image — fill the buffer while not full (return the image);
+    afterwards 50%: swap with a random stored image and return the old
+    one, else return the image. Pure: returns (out_images, new_pool)."""
+    size = pool["images"].shape[0]
+    keys = jax.random.split(key, images.shape[0])
+
+    def body(carry, x):
+        buf, count = carry
+        img, k = x
+        kp, ki = jax.random.split(k)
+        p = jax.random.uniform(kp)
+        rid = jax.random.randint(ki, (), 0, size)
+
+        def insert(_):
+            return (
+                jax.lax.dynamic_update_index_in_dim(buf, img, count, 0),
+                count + 1,
+                img,
+            )
+
+        def mature(_):
+            stored = buf[rid]
+            take = p > 0.5
+            new_buf = jnp.where(take, buf.at[rid].set(img), buf)
+            out = jnp.where(take, stored, img)
+            return new_buf, count, out
+
+        buf2, count2, out = jax.lax.cond(count < size, insert, mature, None)
+        return (buf2, count2), out
+
+    (buf, count), outs = jax.lax.scan(
+        body, (pool["images"], pool["count"]), (images, keys)
+    )
+    return outs, {"images": buf, "count": count}
+
+
+# ------------------------------------------------------------ CycleGAN
+
+
+def create_cyclegan_state(
+    generator, discriminator, *, image_size: int = 256,
+    lr_schedule=2e-4, beta1: float = 0.5, pool_size: int = POOL_SIZE,
+    rng: int | jax.Array = 0,
+) -> GANState:
+    """Two Adams (β1=0.5) over {G_a2b+G_b2a} and {D_a+D_b}
+    (ref: CycleGAN/tensorflow/train.py:122-127); ``lr_schedule`` may be a
+    float or an optax schedule (schedules.linear_decay for ref parity)."""
+    if isinstance(rng, int):
+        rng = jax.random.key(rng)
+    ks = jax.random.split(rng, 4)
+    x = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    nets = {}
+    for name, net, k in (
+        ("gen_a2b", generator, ks[0]), ("gen_b2a", generator, ks[1]),
+        ("dis_a", discriminator, ks[2]), ("dis_b", discriminator, ks[3]),
+    ):
+        nets[name] = net.init({"params": k}, x, train=True)
+    params = {n: v["params"] for n, v in nets.items()}
+    stats = {n: v.get("batch_stats", {}) for n, v in nets.items()}
+    gp = {k: params[k] for k in ("gen_a2b", "gen_b2a")}
+    dp = {k: params[k] for k in ("dis_a", "dis_b")}
+    g_tx = optax.adam(lr_schedule, b1=beta1)
+    d_tx = optax.adam(lr_schedule, b1=beta1)
+    shape = (image_size, image_size, 3)
+    return GANState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=stats,
+        opt_state={"generator": g_tx.init(gp),
+                   "discriminator": d_tx.init(dp)},
+        extra_vars={"pool_a2b": create_pool(pool_size, shape),
+                    "pool_b2a": create_pool(pool_size, shape)},
+        g_apply=generator.apply,
+        d_apply=discriminator.apply,
+        g_tx=g_tx,
+        d_tx=d_tx,
+    )
+
+
+def cyclegan_train_step(state: GANState, batch: dict, key: jax.Array):
+    """One two-phase step on {'a','b'} image batches (ref: train.py:249-255).
+
+    Phase 1 updates both generators (LSGAN + λ·cycle + λ_id·identity);
+    phase 2 updates both discriminators on real vs POOLED fakes ×0.5.
+    Discriminator BN statistics also update during phase 1, mirroring the
+    reference's ``training=True`` critic calls inside the generator tape
+    (ref: train.py:170-175).
+    """
+    real_a, real_b = batch["a"], batch["b"]
+    k_pool_a2b, k_pool_b2a = jax.random.split(key)
+
+    def gen_apply(params, stats, x):
+        out, mut = state.g_apply(
+            {"params": params, "batch_stats": stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+        return out, mut.get("batch_stats", stats)
+
+    def dis_apply(params, stats, x):
+        out, mut = state.d_apply(
+            {"params": params, "batch_stats": stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+        return out, mut.get("batch_stats", stats)
+
+    # ---- Phase 1: generators (ref: train.py:150-205)
+    def g_loss_fn(gp):
+        s = dict(state.batch_stats)
+        fake_a2b, s["gen_a2b"] = gen_apply(
+            gp["gen_a2b"], s["gen_a2b"], real_a
+        )
+        recon_b2a, s["gen_b2a"] = gen_apply(
+            gp["gen_b2a"], s["gen_b2a"], fake_a2b
+        )
+        fake_b2a, s["gen_b2a"] = gen_apply(
+            gp["gen_b2a"], s["gen_b2a"], real_b
+        )
+        recon_a2b, s["gen_a2b"] = gen_apply(
+            gp["gen_a2b"], s["gen_a2b"], fake_b2a
+        )
+        identity_a2b, s["gen_a2b"] = gen_apply(
+            gp["gen_a2b"], s["gen_a2b"], real_b
+        )
+        identity_b2a, s["gen_b2a"] = gen_apply(
+            gp["gen_b2a"], s["gen_b2a"], real_a
+        )
+        logits_b, s["dis_b"] = dis_apply(
+            state.params["dis_b"], s["dis_b"], fake_a2b
+        )
+        logits_a, s["dis_a"] = dis_apply(
+            state.params["dis_a"], s["dis_a"], fake_b2a
+        )
+        loss_gan_a2b = _lsgan(logits_b, True)
+        loss_gan_b2a = _lsgan(logits_a, True)
+        loss_cycle_a = _l1(recon_b2a, real_a)
+        loss_cycle_b = _l1(recon_a2b, real_b)
+        loss_id_a2b = _l1(identity_a2b, real_b)
+        loss_id_b2a = _l1(identity_b2a, real_a)
+        total = (
+            loss_gan_a2b + loss_gan_b2a
+            + (loss_cycle_a + loss_cycle_b) * LAMBDA_CYCLE
+            + (loss_id_a2b + loss_id_b2a) * LAMBDA_ID
+        )
+        metrics = {
+            "loss_gen_a2b": loss_gan_a2b, "loss_gen_b2a": loss_gan_b2a,
+            "loss_cycle_a2b2a": loss_cycle_a, "loss_cycle_b2a2b": loss_cycle_b,
+            "loss_id_a2b": loss_id_a2b, "loss_id_b2a": loss_id_b2a,
+            "loss_gen_total": total,
+        }
+        return total, (s, fake_a2b, fake_b2a, metrics)
+
+    gp = {k: state.params[k] for k in ("gen_a2b", "gen_b2a")}
+    (_, (stats1, fake_a2b, fake_b2a, g_metrics)), g_grads = (
+        jax.value_and_grad(g_loss_fn, has_aux=True)(gp)
+    )
+    g_up, g_opt = state.g_tx.update(
+        g_grads, state.opt_state["generator"], gp
+    )
+    new_gp = optax.apply_updates(gp, g_up)
+
+    # ---- Pool query on the fresh fakes (ref: train.py:251-252)
+    pooled_a2b, pool_a2b = pool_query(
+        state.extra_vars["pool_a2b"], jax.lax.stop_gradient(fake_a2b),
+        k_pool_a2b,
+    )
+    pooled_b2a, pool_b2a = pool_query(
+        state.extra_vars["pool_b2a"], jax.lax.stop_gradient(fake_b2a),
+        k_pool_b2a,
+    )
+
+    # ---- Phase 2: discriminators (ref: train.py:207-245)
+    def d_loss_fn(dp):
+        s = dict(stats1)
+        ra, s["dis_a"] = dis_apply(dp["dis_a"], s["dis_a"], real_a)
+        fa, s["dis_a"] = dis_apply(dp["dis_a"], s["dis_a"], pooled_b2a)
+        rb, s["dis_b"] = dis_apply(dp["dis_b"], s["dis_b"], real_b)
+        fb, s["dis_b"] = dis_apply(dp["dis_b"], s["dis_b"], pooled_a2b)
+        loss_a = (_lsgan(ra, True) + _lsgan(fa, False)) * 0.5
+        loss_b = (_lsgan(rb, True) + _lsgan(fb, False)) * 0.5
+        total = loss_a + loss_b
+        return total, (s, {"loss_dis_a": loss_a, "loss_dis_b": loss_b,
+                           "loss_dis_total": total})
+
+    dp = {k: state.params[k] for k in ("dis_a", "dis_b")}
+    (_, (stats2, d_metrics)), d_grads = jax.value_and_grad(
+        d_loss_fn, has_aux=True
+    )(dp)
+    d_up, d_opt = state.d_tx.update(
+        d_grads, state.opt_state["discriminator"], dp
+    )
+    new_dp = optax.apply_updates(dp, d_up)
+
+    new_state = state.replace(
+        step=state.step + 1,
+        params={**new_gp, **new_dp},
+        batch_stats=stats2,
+        opt_state={"generator": g_opt, "discriminator": d_opt},
+        extra_vars={"pool_a2b": pool_a2b, "pool_b2a": pool_b2a},
+    )
+    return new_state, {**g_metrics, **d_metrics}
+
+
+def cyclegan_translate(state: GANState, images, direction: str = "a2b"):
+    """Eval-mode translation (ref: CycleGAN/tensorflow/inference.py:34-68)."""
+    name = f"gen_{direction}"
+    return state.g_apply(
+        {"params": state.params[name],
+         "batch_stats": state.batch_stats[name]},
+        images, train=False,
+    )
+
+
+def fit_gan(
+    state: GANState,
+    train_step,
+    train_data,
+    mesh,
+    *,
+    epochs: int,
+    workdir: str = "runs/gan",
+    save_every: int = 2,
+    log_every: int = 50,
+    resume: bool = False,
+    resume_epoch: int | None = None,
+):
+    """Minimal GAN epoch loop: compiled step + loggers + TB + Orbax saves
+    every ``save_every`` epochs keeping 3 (ref: DCGAN/tensorflow/main.py:39,
+    80-83; CycleGAN saves every epoch with the epoch tracked in the
+    checkpoint, ref: train.py:329-333 — pass save_every=1)."""
+    from deepvision_tpu.core import shard_batch
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
+
+    mgr = CheckpointManager(f"{workdir}/ckpt")
+    loggers = Loggers()
+    tb = TensorBoardWriter(f"{workdir}/tb")
+    start_epoch = 0
+    if resume and mgr.latest_epoch() is not None:
+        state, meta = mgr.restore(state, resume_epoch)
+        start_epoch = meta["epoch"] + 1
+        if meta.get("loggers"):
+            loggers = meta["loggers"]
+    step = compile_train_step(train_step, mesh)
+    key = jax.random.key(np.uint32(1234))
+    for epoch in range(start_epoch, epochs):
+        t0 = time.time()
+        fetched = []
+        for i, batch in enumerate(train_data(epoch)):
+            key, sub = jax.random.split(key)
+            state, metrics = step(state, shard_batch(mesh, batch), sub)
+            fetched.append(metrics)
+            if log_every and i % log_every == 0:
+                host = {k: float(v) for k, v in fetched[-1].items()}
+                print(f"[epoch {epoch} batch {i}] " + " ".join(
+                    f"{k}={v:.4f}" for k, v in sorted(host.items())
+                ), flush=True)
+        epoch_metrics = {
+            k: float(np.mean([float(m[k]) for m in fetched]))
+            for k in (fetched[0] if fetched else {})
+        }
+        loggers.log_metrics(epoch, epoch_metrics)
+        for k, v in epoch_metrics.items():
+            tb.scalar(k, v, epoch)
+        # wall-clock per epoch, the reference's only perf signal
+        # (ref: DCGAN/tensorflow/main.py:85, CycleGAN/train.py:335-336)
+        print(f"[epoch {epoch}] " + " ".join(
+            f"{k}={v:.4f}" for k, v in sorted(epoch_metrics.items())
+        ) + f" time={time.time() - t0:.1f}s", flush=True)
+        if (epoch + 1) % save_every == 0 or epoch == epochs - 1:
+            mgr.save(epoch, state, loggers=loggers)
+    tb.flush()
+    mgr.close()
+    return state, loggers

@@ -1,0 +1,203 @@
+"""GANs: DCGAN G/D shapes + train smoke (ref: DCGAN/tensorflow/models.py,
+main.py:57-76), functional ImagePool semantics vs an independent host
+reimplementation of the reference's eager buffer
+(ref: CycleGAN/tensorflow/utils.py:32-61), LinearDecay schedule fixture
+(ref: utils.py:5-28), and a CycleGAN two-phase train smoke
+(ref: train.py:150-255).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepvision_tpu.models import get_model
+from deepvision_tpu.train.gan import (
+    create_cyclegan_state,
+    create_dcgan_state,
+    create_pool,
+    cyclegan_train_step,
+    cyclegan_translate,
+    dcgan_sample,
+    dcgan_train_step,
+    pool_query,
+)
+from deepvision_tpu.train.schedules import linear_decay
+
+# --------------------------------------------------------------- DCGAN
+
+
+def test_dcgan_shapes():
+    g = get_model("dcgan_generator")
+    d = get_model("dcgan_discriminator")
+    z = np.zeros((2, 100), np.float32)
+    gv = g.init(jax.random.key(0), z, train=False)
+    img = g.apply(gv, z, train=False)
+    assert img.shape == (2, 28, 28, 1)
+    assert float(jnp.max(jnp.abs(img))) <= 1.0  # tanh range
+    dv = d.init({"params": jax.random.key(1), "dropout": jax.random.key(2)},
+                img, train=False)
+    logits = d.apply(dv, img, train=False)
+    assert logits.shape == (2, 1)
+
+
+def test_dcgan_train_step_updates_both_and_learns(mesh8):
+    from deepvision_tpu.core import shard_batch
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.data.mnist import synthetic_mnist
+
+    imgs, _ = synthetic_mnist(16)
+    # synthetic_mnist yields 32² [0,1]-ish; DCGAN wants 28² in [-1,1]
+    imgs = imgs[:, 2:30, 2:30, :] * 2.0 - 1.0
+    g = get_model("dcgan_generator")
+    d = get_model("dcgan_discriminator")
+    state = create_dcgan_state(g, d)
+    step = compile_train_step(dcgan_train_step, mesh8)
+    batch = shard_batch(mesh8, {"image": imgs.astype(np.float32)})
+    key = jax.random.key(0)
+    g0 = jax.tree.leaves(state.params["generator"])[0].copy()
+    d0 = jax.tree.leaves(state.params["discriminator"])[0].copy()
+    metrics = None
+    for i in range(3):
+        state, metrics = step(state, batch, jax.random.fold_in(key, i))
+    assert np.isfinite(float(metrics["g_loss"]))
+    assert np.isfinite(float(metrics["d_loss"]))
+    assert not np.allclose(jax.tree.leaves(state.params["generator"])[0], g0)
+    assert not np.allclose(
+        jax.tree.leaves(state.params["discriminator"])[0], d0
+    )
+    sample = dcgan_sample(state, key, n=4)
+    assert sample.shape == (4, 28, 28, 1)
+
+
+# ----------------------------------------------------------- ImagePool
+
+
+class _RefPool:
+    """Independent host reimplementation of the reference's eager pool
+    (utils.py:32-61), driven by the same random draws."""
+
+    def __init__(self, size):
+        self.size = size
+        self.pool = []
+
+    def query(self, images, draws):
+        out = []
+        for img, (p, rid) in zip(images, draws):
+            if len(self.pool) < self.size:
+                self.pool.append(img)
+                out.append(img)
+            elif p > 0.5:
+                out.append(self.pool[rid])
+                self.pool[rid] = img
+            else:
+                out.append(img)
+        return out
+
+
+def test_pool_matches_reference_semantics():
+    size, shape = 4, (2, 2, 1)
+    pool = create_pool(size, shape)
+    ref = _RefPool(size)
+    key = jax.random.key(7)
+    rng = np.random.default_rng(3)
+    for step in range(6):
+        images = rng.normal(size=(3, *shape)).astype(np.float32)
+        key, sub = jax.random.split(key)
+        # replay the device draws on the host for the reference pool
+        keys = jax.random.split(sub, 3)
+        draws = []
+        for k in keys:
+            kp, ki = jax.random.split(k)
+            draws.append((
+                float(jax.random.uniform(kp)),
+                int(jax.random.randint(ki, (), 0, size)),
+            ))
+        out, pool = pool_query(pool, jnp.array(images), sub)
+        want = ref.query(list(images), draws)
+        np.testing.assert_allclose(
+            np.asarray(out), np.stack(want), atol=1e-6
+        )
+    np.testing.assert_allclose(
+        np.asarray(pool["images"]), np.stack(ref.pool), atol=1e-6
+    )
+
+
+def test_pool_fill_phase_returns_input():
+    pool = create_pool(8, (1,))
+    imgs = jnp.arange(4, dtype=jnp.float32)[:, None]
+    out, pool = pool_query(pool, imgs, jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(imgs))
+    assert int(pool["count"]) == 4
+
+
+# ------------------------------------------------------------ schedule
+
+
+def test_linear_decay_fixture():
+    s = linear_decay(0.1, total_steps=100, decay_start=60)
+    assert float(s(0)) == pytest.approx(0.1)
+    assert float(s(60)) == pytest.approx(0.1)
+    assert float(s(80)) == pytest.approx(0.05)
+    assert float(s(100)) == pytest.approx(0.0)
+
+
+# ------------------------------------------------------------ CycleGAN
+
+
+def test_cyclegan_models_shapes():
+    g = get_model("cyclegan_generator", n_blocks=2)
+    d = get_model("cyclegan_discriminator")
+    x = np.zeros((1, 64, 64, 3), np.float32)
+    gv = g.init(jax.random.key(0), x, train=False)
+    y = g.apply(gv, x, train=False)
+    assert y.shape == (1, 64, 64, 3)
+    dv = d.init(jax.random.key(1), x, train=False)
+    patch = d.apply(dv, x, train=False)
+    assert patch.shape == (1, 8, 8, 1)  # 70x70 PatchGAN logit map at /8
+
+
+def test_cyclegan_train_step(mesh8):
+    from deepvision_tpu.core import shard_batch
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.data.gan import synthetic_unpaired
+
+    a, b = synthetic_unpaired(n=8, size=64)
+    g = get_model("cyclegan_generator", n_blocks=2)
+    d = get_model("cyclegan_discriminator")
+    state = create_cyclegan_state(g, d, image_size=64, pool_size=4)
+    step = compile_train_step(cyclegan_train_step, mesh8)
+    batch = shard_batch(mesh8, {"a": a, "b": b})
+    key = jax.random.key(0)
+    metrics = None
+    for i in range(3):
+        state, metrics = step(state, batch, jax.random.fold_in(key, i))
+    for k in ("loss_gen_total", "loss_dis_total", "loss_cycle_a2b2a",
+              "loss_id_a2b", "loss_dis_a", "loss_dis_b"):
+        assert np.isfinite(float(metrics[k])), k
+    # pool filled with fakes after 3 steps of batch 8 (size 4)
+    assert int(state.extra_vars["pool_a2b"]["count"]) == 4
+    out = cyclegan_translate(state, a[:2], "a2b")
+    assert out.shape == (2, 64, 64, 3)
+
+
+def test_cyclegan_checkpoint_roundtrip(tmp_path):
+    """GANState mirrors TrainState's field names so the shared Orbax
+    CheckpointManager handles it (incl. pools in extra_vars)."""
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+
+    g = get_model("cyclegan_generator", n_blocks=1)
+    d = get_model("cyclegan_discriminator")
+    state = create_cyclegan_state(g, d, image_size=64, pool_size=2)
+    state = state.replace(step=state.step + 5)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(1, state)
+    fresh = create_cyclegan_state(g, d, image_size=64, pool_size=2, rng=9)
+    restored, meta = mgr.restore(fresh)
+    assert int(restored.step) == 5
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(restored.params["gen_a2b"])[0]),
+        np.asarray(jax.tree.leaves(state.params["gen_a2b"])[0]),
+    )
+    mgr.close()

@@ -56,6 +56,9 @@ def main():
     if args.batch_size:
         cfg["batch_size"] = args.batch_size
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    if cfg["dataset"].startswith("gan"):
+        run_gan(args, cfg, dtype)
+        return
     if cfg["dataset"] == "pose":
         model = get_model(args.model, dtype=dtype,
                           num_heatmaps=cfg["num_heatmaps"])
@@ -194,6 +197,98 @@ def main():
         trainer.resume(args.checkpoint)
         print(f"resumed at epoch {trainer.start_epoch}")
     trainer.fit(args.epochs)
+
+
+def run_gan(args, cfg, dtype):
+    """GAN path: two-network state + fit_gan loop (train/gan.py)."""
+    import jax
+
+    from deepvision_tpu.core import create_mesh
+    from deepvision_tpu.data.mnist import synthetic_mnist
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.gan import (
+        create_cyclegan_state,
+        create_dcgan_state,
+        cyclegan_train_step,
+        dcgan_train_step,
+        fit_gan,
+    )
+    from deepvision_tpu.train.schedules import linear_decay
+
+    mesh = create_mesh()
+    bs = cfg["batch_size"]
+    epochs = args.epochs or cfg["total_epochs"]
+    workdir = f"{args.workdir}/{cfg['name']}"
+
+    if cfg["name"] == "dcgan":
+        from deepvision_tpu.data.mnist import load_mnist_idx
+        from deepvision_tpu.data.padding import iter_array_batches
+
+        if args.data_dir:
+            import os
+
+            imgs, _ = load_mnist_idx(
+                os.path.join(args.data_dir, "train-images-idx3-ubyte"),
+                os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+                pad_to_32=False,
+            )
+        else:
+            imgs, _ = synthetic_mnist(args.synthetic_size)
+            imgs = imgs[:, 2:30, 2:30, :]  # 28² (DCGAN geometry)
+        imgs = (imgs * 2.0 - 1.0).astype(np.float32)  # [-1, 1] (ref :26)
+        rng = np.random.default_rng(0)
+        train_data = lambda e: iter_array_batches(
+            {"image": imgs}, bs, rng=rng
+        )
+        state = create_dcgan_state(
+            get_model("dcgan_generator", dtype=dtype),
+            get_model("dcgan_discriminator", dtype=dtype),
+            noise_dim=cfg["noise_dim"],
+            lr=cfg["optimizer_params"]["lr"],
+        )
+        step_fn = dcgan_train_step
+    else:  # cyclegan
+        size = cfg["input_size"]
+        if args.data_dir:
+            from deepvision_tpu.data.gan import make_cyclegan_data
+
+            steps = args.steps_per_epoch or 1000 // bs
+            train_data = make_cyclegan_data(
+                args.data_dir, bs, size, steps_per_epoch=steps
+            )
+        else:
+            from deepvision_tpu.data.gan import synthetic_unpaired
+            from deepvision_tpu.data.padding import iter_array_batches
+
+            size = min(size, 64)
+            a, b = synthetic_unpaired(args.synthetic_size, size=size)
+            rng = np.random.default_rng(0)
+            steps = len(a) // bs
+            train_data = lambda e: iter_array_batches(
+                {"a": a, "b": b}, bs, rng=rng
+            )
+        lr = linear_decay(
+            cfg["optimizer_params"]["lr"],
+            cfg["total_epochs"] * steps,
+            cfg["decay_epochs"] * steps,
+        )
+        state = create_cyclegan_state(
+            get_model("cyclegan_generator", dtype=dtype),
+            get_model("cyclegan_discriminator", dtype=dtype),
+            image_size=size,
+            lr_schedule=lr,
+            beta1=cfg["optimizer_params"]["beta1"],
+        )
+        step_fn = cyclegan_train_step
+
+    print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
+    fit_gan(
+        state, step_fn, train_data, mesh,
+        epochs=epochs, workdir=workdir,
+        save_every=cfg.get("save_every", 2),
+        resume=args.resume or args.checkpoint is not None,
+        resume_epoch=args.checkpoint,
+    )
 
 
 if __name__ == "__main__":
