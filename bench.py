@@ -1,20 +1,34 @@
-"""Benchmark: ResNet-50 ImageNet training throughput, images/sec/chip.
+"""Benchmark: ResNet-50 ImageNet training throughput + MFU on the chip.
 
-Runs the full compiled train step (forward + backward + SGD update, bf16
-compute / f32 params, donated state) on synthetic 224x224 batches on the
-locally attached TPU chip(s) and prints ONE JSON line.
+Measures the full compiled train step (forward + backward + SGD update,
+bf16 compute / f32 params, donated state) on the locally attached TPU
+chip(s), twice:
 
-Baseline for ``vs_baseline``: the reference trained ResNet-50 on P100-class
-GPUs (ref: ResNet/pytorch/README.md:67, AlexNet/pytorch/README.md:24 — the
-repo's documented hardware). It publishes no throughput number for ResNet-50
+1. device-resident synthetic batches (pure step throughput — the
+   headline ``value``), with MFU computed from the compiled executable's
+   XLA cost analysis against the chip's peak bf16 FLOP/s;
+2. fed by the real tf.data ImageNet pipeline over synthetic TFRecords
+   (JPEG decode + ResNet preprocessing on the host), proving the input
+   pipeline sustains the device rate (SURVEY §7 hard part #1).
+
+Prints ONE JSON line. Baseline for ``vs_baseline``: the reference trained
+ResNet-50 on P100-class GPUs (ref: ResNet/pytorch/README.md:67,
+AlexNet/pytorch/README.md:24); it publishes no throughput number
 (BASELINE.json "published" is empty), so we use the widely reported ~220
-images/sec for fp32 ResNet-50 training on one P100 as the per-chip baseline.
+images/sec for fp32 ResNet-50 training on one P100 as the per-chip
+baseline.
+
+Set ``BENCH_PROFILE=1`` to capture a ``jax.profiler`` trace of the
+measured steps into ``/tmp/deepvision_bench_trace`` (view in
+TensorBoard's profile plugin).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +38,52 @@ import optax
 BASELINE_IMG_PER_SEC_PER_CHIP = 220.0  # fp32 ResNet-50 on the ref's P100
 BATCH_PER_CHIP = 256
 WARMUP, MEASURE = 3, 20
+PIPELINE_IMAGES = 4096  # synthetic TFRecord set size for the fed bench
+
+# Peak bf16 FLOP/s by device kind (public spec sheets); unknown kinds
+# fall back to 100 TF/s so MFU is at least order-of-magnitude meaningful.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6e": 918e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def _flops_per_step(compiled) -> float | None:
+    """XLA's own FLOP count for one compiled step (per-device: cost
+    analysis runs on the post-SPMD-partitioned executable); None if
+    unavailable."""
+    try:
+        flops = float(compiled.cost_analysis().get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _write_synthetic_tfrecords(root: Path, n: int) -> None:
+    """JPEG-encoded 256² noise-with-structure records in the ImageNet
+    schema (image/encoded + image/class/label), 8 shards."""
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    from deepvision_tpu.data.tfrecord import encode_example, write_records
+
+    rng = np.random.default_rng(0)
+    shards = 8
+    per = n // shards
+    for s in range(shards):
+        records = []
+        for _ in range(per):
+            img = rng.integers(0, 255, (256, 256, 3), np.uint8)
+            data = tf.io.encode_jpeg(tf.constant(img)).numpy()
+            records.append(encode_example({
+                "image/encoded": [data],
+                "image/class/label": [int(rng.integers(1, 1001))],
+            }))
+        write_records(root / f"train-{s:05d}-of-{shards:05d}", records)
 
 
 def main() -> None:
@@ -50,6 +110,11 @@ def main() -> None:
 
     device_batch = shard_batch(mesh, batch)
     key = jax.random.key(0)
+    # Lower+compile explicitly so the executable's cost analysis is
+    # available for the MFU figure.
+    compiled = step.lower(state, device_batch, key).compile()
+    flops_step = _flops_per_step(compiled)
+
     for _ in range(WARMUP):
         key, sub = jax.random.split(key)
         state, metrics = step(state, device_batch, sub)
@@ -59,21 +124,81 @@ def main() -> None:
     # dependency chain instead.
     float(state.params["fc"]["bias"][0])
 
+    profile_dir = None
+    if os.environ.get("BENCH_PROFILE"):
+        profile_dir = "/tmp/deepvision_bench_trace"
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     for _ in range(MEASURE):
         key, sub = jax.random.split(key)
         state, metrics = step(state, device_batch, sub)
     float(state.params["fc"]["bias"][0])
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     img_per_sec = MEASURE * batch_size / dt
     per_chip = img_per_sec / n_chips
-    print(json.dumps({
+
+    mfu = None
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 100e12)
+    if flops_step:
+        # flops_step is already per-device (see _flops_per_step)
+        achieved = flops_step * MEASURE / dt
+        mfu = achieved / peak
+
+    # ---- pipeline-fed: tf.data JPEG decode + ResNet preprocessing,
+    # uint8 wire transfer (4× less host↔device traffic; normalization
+    # happens on device in the step) + double-buffered device_put ----
+    pipeline_per_chip = None
+    try:
+        from deepvision_tpu.data.device_put import device_prefetch
+        from deepvision_tpu.data.imagenet import make_dataset
+
+        root = Path("/tmp/deepvision_bench_tfrecords")
+        done = root / "COMPLETE"
+        if not done.exists():  # all-or-nothing cache marker
+            root.mkdir(parents=True, exist_ok=True)
+            _write_synthetic_tfrecords(root, PIPELINE_IMAGES)
+            done.touch()
+        ds = make_dataset(str(root / "train-*"), batch_size, 224,
+                          is_training=True, as_uint8=True)
+        fed_warmup, fed_steps = 2, 10
+
+        def host_batches():
+            it = ds.as_numpy_iterator()
+            for _ in range(fed_warmup + fed_steps):
+                img, lbl = next(it)
+                yield {"image": img, "label": lbl}
+
+        t0 = None
+        for i, dbatch in enumerate(device_prefetch(host_batches(), mesh)):
+            if i == fed_warmup:
+                float(state.params["fc"]["bias"][0])  # drain warmup
+                t0 = time.perf_counter()
+            key, sub = jax.random.split(key)
+            state, _ = step(state, dbatch, sub)
+        float(state.params["fc"]["bias"][0])
+        fed_dt = time.perf_counter() - t0
+        pipeline_per_chip = fed_steps * batch_size / fed_dt / n_chips
+    except Exception as e:  # pipeline bench is best-effort
+        import sys
+
+        print(f"# pipeline bench skipped: {e!r}", file=sys.stderr)
+
+    out = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
-    }))
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "device_kind": kind,
+        "pipeline_fed_images_per_sec_per_chip": (
+            round(pipeline_per_chip, 1) if pipeline_per_chip else None
+        ),
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

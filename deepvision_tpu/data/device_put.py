@@ -24,3 +24,23 @@ def global_batch_size(mesh, per_device_batch: int) -> int:
     """per-device batch × all mesh data-axis devices (the reference's
     global-batch arithmetic, ref: YOLO/tensorflow/train.py:282)."""
     return per_device_batch * mesh.shape["data"]
+
+
+def device_prefetch(batches, mesh, *, depth: int = 2):
+    """Double-buffered host→device transfer: keep ``depth`` batches'
+    ``device_put`` dispatched ahead of the consumer so the wire transfer
+    overlaps the running step (jax transfers are async — the classic TPU
+    input double-buffering the reference's ``prefetch(1)`` does on the
+    host side only, ref: ResNet/tensorflow/train.py:195-204).
+    """
+    import collections
+
+    from deepvision_tpu.core.mesh import shard_batch
+
+    queue = collections.deque()
+    for batch in batches:
+        queue.append(shard_batch(mesh, batch))
+        if len(queue) > depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

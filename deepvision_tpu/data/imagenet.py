@@ -45,8 +45,16 @@ def _tf():
     return tf
 
 
-def parse_and_preprocess(serialized, size: int, is_training: bool):
-    """One Example -> (f32 image [size,size,3] mean-subtracted, int32 label)."""
+def parse_and_preprocess(serialized, size: int, is_training: bool,
+                         as_uint8: bool = False):
+    """One Example -> (image [size,size,3], int32 label).
+
+    Default emits f32 mean-subtracted images (full reference parity).
+    ``as_uint8`` emits rounded uint8 crops WITHOUT mean subtraction — 4×
+    less host↔device wire traffic; the train step applies
+    ``ops.normalize.imagenet_normalize`` on device (TPU-first: HBM
+    bandwidth is cheaper than host link bandwidth).
+    """
     tf = _tf()
     feats = tf.io.parse_single_example(
         serialized,
@@ -75,7 +83,11 @@ def parse_and_preprocess(serialized, size: int, is_training: bool):
         off_h = (new_h - size) // 2
         off_w = (new_w - size) // 2
         image = tf.slice(image, [off_h, off_w, 0], [size, size, 3])
-    image = image - tf.constant(CHANNEL_MEANS, tf.float32)
+    if as_uint8:
+        image = tf.cast(tf.clip_by_value(tf.round(image), 0.0, 255.0),
+                        tf.uint8)
+    else:
+        image = image - tf.constant(CHANNEL_MEANS, tf.float32)
 
     label = tf.cast(feats["image/class/label"], tf.int32) - 1
     return image, label
@@ -90,6 +102,7 @@ def make_dataset(
     shuffle_buffer: int = 10_000,
     num_process: int = 1,
     process_index: int = 0,
+    as_uint8: bool = False,
 ):
     """tf.data pipeline over sharded TFRecords; per-host file sharding for
     multi-host (the ``experimental_distribute_dataset`` analog —
@@ -105,7 +118,7 @@ def make_dataset(
     if is_training:
         ds = ds.shuffle(shuffle_buffer).repeat()
     ds = ds.map(
-        lambda s: parse_and_preprocess(s, size, is_training),
+        lambda s: parse_and_preprocess(s, size, is_training, as_uint8),
         num_parallel_calls=tf.data.AUTOTUNE,
     )
     ds = ds.batch(batch_size, drop_remainder=is_training)
@@ -129,19 +142,24 @@ def _as_batches(ds, limit: int | None = None, pad_to: int | None = None):
 def make_imagenet_data(
     data_dir: str, batch_size: int, size: int = 224,
     *, train_images: int = 1_281_167, val_images: int = 50_000,
+    train_as_uint8: bool = True,
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
     Shard-name layout follows the reference builder: 1024 train / 128 val
     shards named ``train-*-of-*`` / ``validation-*-of-*``
     (ref: build_imagenet_tfrecord.py:111-114).
+
+    Training batches default to uint8 wire transfer (mean subtraction on
+    device — ops/normalize.py; <0.5-LSB rounding vs the reference's f32
+    path); validation stays f32 for exact preprocessing parity.
     """
     d = Path(data_dir)
     steps = train_images // batch_size
 
     def train_data(epoch: int):
         ds = make_dataset(str(d / "train-*"), batch_size, size,
-                          is_training=True)
+                          is_training=True, as_uint8=train_as_uint8)
         return _as_batches(ds, steps)
 
     def val_data():

@@ -1,0 +1,37 @@
+"""On-device image normalization for uint8 wire transfer.
+
+TPU-first bandwidth optimization: host pipelines may emit uint8 images
+(4× less host↔device traffic than f32 — the link, not HBM, is the scarce
+resource; data/imagenet.py ``as_uint8``); the compiled step then applies
+the dataset family's normalization on device. Train steps call
+:func:`maybe_normalize` so f32 batches (full preprocessing parity done on
+the host) pass through untouched.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+IMAGENET_CHANNEL_MEANS = (123.68, 116.78, 103.94)  # ref: data_load.py:35-38
+
+
+def imagenet_normalize(images: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [0,255] → f32 channel-mean-subtracted (classification nets)."""
+    return images.astype(jnp.float32) - jnp.asarray(
+        IMAGENET_CHANNEL_MEANS, jnp.float32
+    )
+
+
+def tanh_normalize(images: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [0,255] → f32 [-1,1] (detection/pose/GAN nets — the
+    reference's /127.5 - 1, e.g. YOLO/tensorflow/preprocess.py:24-25)."""
+    return images.astype(jnp.float32) / 127.5 - 1.0
+
+
+def maybe_normalize(images: jnp.ndarray, kind: str = "imagenet"):
+    """Normalize on device iff the batch arrived as uint8."""
+    if images.dtype != jnp.uint8:
+        return images
+    if kind == "imagenet":
+        return imagenet_normalize(images)
+    return tanh_normalize(images)

@@ -13,6 +13,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from deepvision_tpu.ops.normalize import maybe_normalize
 from deepvision_tpu.losses.classification import (
     softmax_cross_entropy,
     softmax_cross_entropy_per_sample,
@@ -26,7 +27,8 @@ def classification_train_step(
     state: TrainState, batch: dict, key: jax.Array
 ) -> tuple[TrainState, dict]:
     """One SGD step on {'image','label'}; returns (new_state, metrics)."""
-    images, labels = batch["image"], batch["label"]
+    images = maybe_normalize(batch["image"])
+    labels = batch["label"]
 
     def loss_fn(params):
         out, mutated = state.apply_fn(
@@ -70,7 +72,8 @@ def yolo_train_step(state: TrainState, batch: dict, key: jax.Array):
     from deepvision_tpu.losses.yolo import yolo_loss
     from deepvision_tpu.ops.yolo_encode import encode_labels
 
-    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    images = maybe_normalize(batch["image"], "tanh")
+    boxes, labels = batch["boxes"], batch["label"]
     size = images.shape[1]
     grid_sizes = (size // 8, size // 16, size // 32)
 
@@ -103,7 +106,8 @@ def yolo_eval_step(state: TrainState, batch: dict) -> dict:
     from deepvision_tpu.losses.yolo import yolo_loss
     from deepvision_tpu.ops.yolo_encode import encode_labels
 
-    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    images = maybe_normalize(batch["image"], "tanh")
+    boxes, labels = batch["boxes"], batch["label"]
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(images.shape[0], jnp.float32)
@@ -130,7 +134,8 @@ def classification_eval_step(state: TrainState, batch: dict) -> dict:
     whole 50k-image set is evaluated with one compiled shape — the
     reference evaluates the full set too (ref: ResNet/pytorch/train.py:488-520).
     """
-    images, labels = batch["image"], batch["label"]
+    images = maybe_normalize(batch["image"])
+    labels = batch["label"]
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(labels.shape[0], jnp.float32)
@@ -161,7 +166,7 @@ def pose_train_step(state: TrainState, batch: dict, key: jax.Array):
     from deepvision_tpu.losses.pose import weighted_heatmap_mse
     from deepvision_tpu.ops.heatmap import gaussian_heatmaps
 
-    images = batch["image"]
+    images = maybe_normalize(batch["image"], "tanh")
     grid = images.shape[1] // 4  # stem downsamples 256² -> 64²
     targets = gaussian_heatmaps(
         batch["kx"], batch["ky"], batch["v"], height=grid, width=grid
@@ -189,7 +194,7 @@ def pose_eval_step(state: TrainState, batch: dict) -> dict:
     from deepvision_tpu.losses.pose import weighted_heatmap_mse
     from deepvision_tpu.ops.heatmap import gaussian_heatmaps
 
-    images = batch["image"]
+    images = maybe_normalize(batch["image"], "tanh")
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(images.shape[0], jnp.float32)
@@ -218,7 +223,8 @@ def centernet_train_step(state: TrainState, batch: dict, key: jax.Array):
     from deepvision_tpu.losses.centernet import centernet_loss
     from deepvision_tpu.ops.centernet_encode import encode_centernet
 
-    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    images = maybe_normalize(batch["image"], "tanh")
+    boxes, labels = batch["boxes"], batch["label"]
     grid = images.shape[1] // 4  # output stride 4
 
     def loss_fn(params):
@@ -246,7 +252,8 @@ def centernet_eval_step(state: TrainState, batch: dict) -> dict:
     from deepvision_tpu.losses.centernet import centernet_loss
     from deepvision_tpu.ops.centernet_encode import encode_centernet
 
-    images, boxes, labels = batch["image"], batch["boxes"], batch["label"]
+    images = maybe_normalize(batch["image"], "tanh")
+    boxes, labels = batch["boxes"], batch["label"]
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(images.shape[0], jnp.float32)

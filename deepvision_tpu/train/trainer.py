@@ -26,6 +26,7 @@ import numpy as np
 
 from deepvision_tpu.core import shard_batch
 from deepvision_tpu.core.step import compile_eval_step, compile_train_step
+from deepvision_tpu.data.device_put import device_prefetch
 from deepvision_tpu.train.checkpoint import CheckpointManager
 from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
 from deepvision_tpu.train.optimizers import make_optimizer, set_lr_scale
@@ -109,11 +110,19 @@ class Trainer:
             )
             pending.clear()
 
-        for i, batch in enumerate(self.train_data(epoch)):
+        def counted():
+            for batch in self.train_data(epoch):
+                counts.append(len(batch["image"]))
+                yield batch
+
+        # double-buffered H2D: the next batch's transfer overlaps the
+        # running step (data/device_put.py)
+        for i, device_batch in enumerate(
+            device_prefetch(counted(), self.mesh)
+        ):
             self._key, sub = jax.random.split(self._key)
-            counts.append(len(batch["image"]))
             self.state, metrics = self._train_step(
-                self.state, shard_batch(self.mesh, batch), sub
+                self.state, device_batch, sub
             )
             pending.append(metrics)
             if self.log_every and i % self.log_every == 0:

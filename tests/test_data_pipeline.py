@@ -125,3 +125,50 @@ def test_voc_builder(tmp_path):
     ex = decode_example(raw)
     np.testing.assert_allclose(ex["image/object/bbox/xmin"], [0.1, 0.0])
     assert ex["image/object/count"] == [2]
+
+
+def test_uint8_wire_transfer_path(tmp_path):
+    """as_uint8 pipeline + on-device normalization ≈ the f32 host path
+    (within u8 rounding of the resized crop)."""
+    import numpy as np
+    import tensorflow as tf
+
+    from deepvision_tpu.data.imagenet import make_dataset
+    from deepvision_tpu.data.tfrecord import encode_example, write_records
+    from deepvision_tpu.ops.normalize import maybe_normalize
+
+    rng = np.random.default_rng(0)
+    records = []
+    for i in range(4):
+        img = rng.integers(0, 255, (300, 280, 3), np.uint8)
+        data = tf.io.encode_jpeg(tf.constant(img)).numpy()
+        records.append(encode_example({
+            "image/encoded": [data],
+            "image/class/label": [i + 1],
+        }))
+    write_records(tmp_path / "validation-00000-of-00001", records)
+
+    kw = dict(batch_size=4, size=224, is_training=False)
+    f32_img, _ = next(make_dataset(
+        str(tmp_path / "validation-*"), **kw
+    ).as_numpy_iterator())
+    u8_img, _ = next(make_dataset(
+        str(tmp_path / "validation-*"), as_uint8=True, **kw
+    ).as_numpy_iterator())
+    assert u8_img.dtype == np.uint8
+    normalized = np.asarray(maybe_normalize(u8_img))
+    assert np.abs(normalized - f32_img).max() <= 0.5001  # u8 rounding
+    # f32 batches pass through maybe_normalize untouched
+    assert maybe_normalize(f32_img) is f32_img
+
+
+def test_device_prefetch_preserves_order(mesh8):
+    import numpy as np
+
+    from deepvision_tpu.data.device_put import device_prefetch
+
+    batches = [{"image": np.full((8, 2), i, np.float32)} for i in range(7)]
+    out = list(device_prefetch(iter(batches), mesh8, depth=2))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        assert float(np.asarray(b["image"])[0, 0]) == i
