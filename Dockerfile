@@ -1,0 +1,25 @@
+# Cloud-submittable training image — the TPU-VM analog of the reference's
+# CUDA image (ref: Hourglass/tensorflow/Dockerfile: nvidia/cuda:10.1 base,
+# pip deps, ENTRYPOINT main.py). TPU access comes from running on a
+# TPU VM (the libtpu runtime ships with the jax[tpu] wheel); no driver
+# layers needed in the image itself.
+
+FROM python:3.12-slim
+
+LABEL project="deepvision-tpu"
+
+ENV LC_ALL=C.UTF-8 \
+    LANG=C.UTF-8 \
+    PYTHONUNBUFFERED=TRUE \
+    PYTHONDONTWRITEBYTECODE=TRUE
+
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    flax optax orbax-checkpoint chex einops numpy \
+    tensorflow-cpu google-cloud-storage
+
+WORKDIR /app
+COPY deepvision_tpu ./deepvision_tpu
+COPY train.py predict.py bench.py ./
+
+ENTRYPOINT ["python", "train.py"]

@@ -1,0 +1,53 @@
+# Ops targets — surface parity with the reference's per-model Makefiles
+# (ref: ResNet/pytorch/Makefile: nohup train_*/resume_* with timestamped
+# logs, tensorboard, process inspection), generalized over one shared CLI.
+#
+#   make train_resnet50 DATA=/data/imagenet   background train + log file
+#   make resume_resnet50                       resume from latest checkpoint
+#   make test | make bench | make dryrun       CI entry points
+#   make tensorboard                           serve ./runs
+
+TIME := `/bin/date "+%Y-%m-%d-%H-%M-%S"`
+DATA ?=
+DATA_FLAG := $(if $(DATA),--data-dir $(DATA),)
+WORKDIR ?= runs
+PY ?= python
+
+MODELS := lenet5 alexnet1 alexnet2 vgg16 vgg19 inception1 inception3 \
+          resnet34 resnet50 resnet152 resnet50v2 mobilenet1 shufflenet1 \
+          darknet53 yolov3 centernet hourglass104 dcgan cyclegan
+
+# make train_<model>: nohup background run with a timestamped log
+# (the reference's crash-survival mechanism, ref: ResNet/pytorch/Makefile)
+train_%:
+	mkdir -p $(WORKDIR) logs
+	nohup $(PY) -u train.py -m $* $(DATA_FLAG) --workdir $(WORKDIR) \
+		> "logs/$*-$(TIME).log" 2>&1 &
+	@echo "started $*; tail -f logs/$*-*.log"
+
+# make resume_<model>: continue from the latest Orbax checkpoint
+resume_%:
+	mkdir -p $(WORKDIR) logs
+	nohup $(PY) -u train.py -m $* $(DATA_FLAG) --workdir $(WORKDIR) \
+		--resume > "logs/$*-resume-$(TIME).log" 2>&1 &
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+# the driver's multi-chip validation, runnable locally on 8 virtual CPUs
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+tensorboard:
+	tensorboard --logdir $(WORKDIR) --port 6006
+
+find-python:
+	ps -ef | grep python
+
+list-models:
+	@echo $(MODELS)
+
+.PHONY: test bench dryrun tensorboard find-python list-models

@@ -26,8 +26,10 @@ from pathlib import Path
 import numpy as np
 
 from deepvision_tpu.data.padding import pad_partial_batch
+from deepvision_tpu.ops.normalize import (
+    IMAGENET_CHANNEL_MEANS as CHANNEL_MEANS,  # single source of truth
+)
 
-CHANNEL_MEANS = (123.68, 116.78, 103.94)  # ref: data_load.py:35-38
 RESIZE_MIN = 256
 
 

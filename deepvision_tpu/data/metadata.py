@@ -1,0 +1,51 @@
+"""Dataset metadata: synset/class-name loaders (VERDICT §2 item 43).
+
+The reference scatters these lookups across notebooks and builder scripts
+(synsets + human maps in ``Datasets/ILSVRC2012/*.txt``, name lists in
+``Datasets/{VOC2007,MSCOCO}/*names.txt``); here one module owns them.
+The backing assets live in ``data/assets/`` (factual dataset constants —
+see assets/README.md for provenance).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from pathlib import Path
+
+ASSETS = Path(__file__).parent / "assets"
+
+
+@lru_cache(maxsize=None)
+def imagenet_synsets() -> list[tuple[str, str]]:
+    """1000 ``(wnid, human_name)`` pairs in label order (label i in
+    [0, 999] ↔ entry i; TFRecord labels are 1-based)."""
+    out = []
+    for line in (ASSETS / "imagenet_synsets.txt").read_text().splitlines():
+        wnid, _, name = line.partition(" ")
+        out.append((wnid, name))
+    return out
+
+
+@lru_cache(maxsize=None)
+def imagenet_wnid_to_index() -> dict[str, int]:
+    """wnid → 0-based label index (the builders' label source)."""
+    return {w: i for i, (w, _) in enumerate(imagenet_synsets())}
+
+
+def imagenet_label_name(index: int) -> str:
+    """0-based label → human-readable name."""
+    return imagenet_synsets()[index][1]
+
+
+@lru_cache(maxsize=None)
+def imagenet_val_synsets() -> list[str]:
+    """Ground-truth synset for each of the 50k validation images in
+    sorted-filename order (for building validation TFRecords)."""
+    return (ASSETS / "imagenet_val_labels.txt").read_text().split()
+
+
+@lru_cache(maxsize=None)
+def class_names(dataset: str) -> list[str]:
+    """Detection class names: ``voc`` (20) or ``mscoco`` (80)."""
+    path = ASSETS / f"{'voc' if dataset == 'voc' else 'mscoco'}_names.txt"
+    return path.read_text().splitlines()

@@ -1,0 +1,46 @@
+"""Model export: serialized StableHLO artifacts (jax.export).
+
+The TPU-native analog of the reference's deployment surface — CycleGAN's
+saved_model + TFLite converter (ref: CycleGAN/tensorflow/convert.py:7-14,
+inference.py:26-72): the jitted forward function is lowered once and
+serialized with its input signature; the artifact reloads and executes
+without the model's Python code.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+
+def export_forward(apply_fn, variables, sample_input, *, train_kwarg=True):
+    """Lower ``apply_fn(variables, x, train=False)`` at the sample's
+    shape/dtype and return the serialized bytes."""
+
+    def forward(x):
+        if train_kwarg:
+            return apply_fn(variables, x, train=False)
+        return apply_fn(variables, x)
+
+    spec = jax.ShapeDtypeStruct(
+        np.shape(sample_input), jnp.asarray(sample_input).dtype
+    )
+    exported = jax_export.export(jax.jit(forward))(spec)
+    return exported.serialize()
+
+
+def save_exported(path: str | Path, data: bytes) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_bytes(data)
+    return path
+
+
+def load_exported(path: str | Path):
+    """-> callable(x) running the deserialized computation."""
+    exported = jax_export.deserialize(Path(path).read_bytes())
+    return exported.call

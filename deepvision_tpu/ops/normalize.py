@@ -30,6 +30,8 @@ def tanh_normalize(images: jnp.ndarray) -> jnp.ndarray:
 
 def maybe_normalize(images: jnp.ndarray, kind: str = "imagenet"):
     """Normalize on device iff the batch arrived as uint8."""
+    if kind not in ("imagenet", "tanh"):
+        raise ValueError(f"unknown normalization kind {kind!r}")
     if images.dtype != jnp.uint8:
         return images
     if kind == "imagenet":

@@ -1,0 +1,52 @@
+"""Best-model publication to GCS (ref: Hourglass/tensorflow/main.py:50-65).
+
+After training, uploads the best checkpoint archive to a bucket and writes
+the ``gs://`` URI to ``/tmp/output.txt`` — the reference's pipeline
+handoff contract. Gated on google-cloud-storage being importable (it is
+not in the baked image; the Dockerfile installs it for cloud runs).
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import tempfile
+from pathlib import Path
+
+
+def publish_to_gcs(
+    model_path: str | Path,
+    bucket_name: str,
+    output_dir: str,
+    *,
+    handoff_file: str = "/tmp/output.txt",
+) -> str | None:
+    """Upload ``model_path`` (file OR checkpoint directory, tarred) to
+    ``gs://bucket/output_dir/``; returns the gs:// URI (None if the GCS
+    client library is unavailable)."""
+    try:
+        from google.cloud import storage  # optional dependency
+    except ImportError:
+        print("google-cloud-storage not installed; skipping upload")
+        return None
+
+    model_path = Path(model_path)
+    tmpdir = None
+    upload_path = model_path
+    if model_path.is_dir():  # Orbax checkpoints are directories
+        tmpdir = tempfile.TemporaryDirectory()
+        upload_path = Path(tmpdir.name) / f"{model_path.name}.tar.gz"
+        with tarfile.open(upload_path, "w:gz") as tar:
+            tar.add(model_path, arcname=model_path.name)
+
+    client = storage.Client()
+    bucket = client.bucket(bucket_name)
+    blob_name = os.path.join(output_dir, upload_path.name)
+    bucket.blob(blob_name).upload_from_filename(str(upload_path))
+    uri = f"gs://{bucket_name}/{blob_name}"
+    if tmpdir is not None:
+        tmpdir.cleanup()
+    print(f"Uploaded model to {uri}")
+    # pipeline handoff (ref: main.py:63-65)
+    Path(handoff_file).write_text(uri + "\n")
+    return uri

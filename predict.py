@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Inference / demo CLI — the reference's notebook surface as commands.
+
+Replaces the per-model demo notebooks (classification predictions
+``ResNet/pytorch/notebooks/ResNet50.ipynb``; box demo
+``YOLO/tensorflow/demo_mscoco.ipynb``; pose demo
+``Hourglass/tensorflow/demo_hourglass_pose.ipynb``; GAN sampling
+``DCGAN/tensorflow/inference.py``; translation + export
+``CycleGAN/tensorflow/inference.py``, ``convert.py``) with one CLI:
+
+    predict.py classify -m resnet50 --workdir runs/resnet50 IMG [IMG...]
+    predict.py detect   -m yolov3   --workdir runs/yolov3 IMG -o out.png
+    predict.py pose     -m hourglass104 --workdir ... IMG -o out.png
+    predict.py dcgan    --workdir runs/dcgan -o samples.png
+    predict.py cyclegan --workdir runs/cyclegan IMG -o out.png
+    predict.py export   -m resnet50 --workdir ... -o resnet50.stablehlo
+
+Checkpoints come from the Trainer/fit_gan Orbax workdirs; with no
+checkpoint present the model runs freshly initialized (still useful for
+pipeline smoke tests) and says so.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+# ----------------------------------------------------------- image io
+
+
+def load_image(path: str, size: int, *, scale: str) -> np.ndarray:
+    """JPEG/PNG → (1, size, size, 3) f32; scale: 'imagenet' | 'tanh'."""
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    data = tf.io.read_file(path)
+    img = tf.io.decode_image(data, channels=3, expand_animations=False)
+    img = tf.image.resize(tf.cast(img, tf.float32), [size, size])
+    img = img.numpy()
+    if scale == "imagenet":
+        from deepvision_tpu.ops.normalize import IMAGENET_CHANNEL_MEANS
+
+        img = img - np.asarray(IMAGENET_CHANNEL_MEANS, np.float32)
+    else:
+        img = img / 127.5 - 1.0
+    return img[None]
+
+
+def save_image(path: str, img: np.ndarray) -> None:
+    """(H, W, C) array in [-1,1] or [0,255] → PNG."""
+    import tensorflow as tf
+
+    if img.dtype != np.uint8:
+        if img.min() < 0 or img.max() <= 1.5:  # tanh range
+            img = (img + 1.0) * 127.5
+        img = np.clip(img, 0, 255).astype(np.uint8)
+    if img.shape[-1] == 1:
+        img = np.repeat(img, 3, axis=-1)
+    tf.io.write_file(path, tf.io.encode_png(tf.constant(img)))
+    print(f"wrote {path}")
+
+
+def draw_box(img: np.ndarray, x1, y1, x2, y2, color, thickness=2):
+    """In-place rectangle on a (H, W, 3) uint8 array."""
+    h, w = img.shape[:2]
+    x1, x2 = sorted((int(np.clip(x1, 0, w - 1)), int(np.clip(x2, 0, w - 1))))
+    y1, y2 = sorted((int(np.clip(y1, 0, h - 1)), int(np.clip(y2, 0, h - 1))))
+    t = thickness
+    img[y1:y1 + t, x1:x2 + 1] = color
+    img[max(y2 - t, 0):y2 + 1, x1:x2 + 1] = color
+    img[y1:y2 + 1, x1:x1 + t] = color
+    img[y1:y2 + 1, max(x2 - t, 0):x2 + 1] = color
+
+
+def draw_dot(img: np.ndarray, x, y, color, radius=3):
+    h, w = img.shape[:2]
+    x, y = int(x), int(y)
+    img[max(y - radius, 0):y + radius + 1,
+        max(x - radius, 0):x + radius + 1] = color
+
+
+_PALETTE = [(255, 64, 64), (64, 255, 64), (64, 64, 255), (255, 255, 64),
+            (255, 64, 255), (64, 255, 255), (255, 160, 64), (160, 64, 255)]
+
+
+# ------------------------------------------------------ model loading
+
+
+def load_state(model_name: str, workdir: str | None, sample, **model_kw):
+    import jax.numpy as jnp
+    import optax
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.state import create_train_state
+
+    model = get_model(model_name, dtype=jnp.float32, **model_kw)
+    state = create_train_state(model, optax.sgd(0.1), sample)
+    if workdir and Path(f"{workdir}/ckpt").exists():
+        from deepvision_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(f"{workdir}/ckpt")
+        if mgr.latest_epoch() is not None:
+            state, meta = mgr.restore(state)
+            print(f"restored epoch {meta['epoch']} from {workdir}/ckpt")
+            mgr.close()
+            return state
+        mgr.close()
+    print("no checkpoint found — running freshly initialized weights")
+    return state
+
+
+def _apply(state, images):
+    variables = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    return state.apply_fn(variables, images, train=False)
+
+
+# --------------------------------------------------------- subcommands
+
+
+def cmd_classify(args):
+    from deepvision_tpu.data.metadata import imagenet_label_name
+
+    size = 299 if args.model == "inception3" else 224
+    imgs = [load_image(p, size, scale="imagenet") for p in args.images]
+    state = load_state(args.model, args.workdir, imgs[0],
+                       num_classes=args.num_classes)
+    for path, img in zip(args.images, imgs):
+        logits = np.asarray(_apply(state, img))
+        if logits.ndim > 2:
+            logits = logits[0]
+        probs = np.exp(logits[0] - logits[0].max())
+        probs /= probs.sum()
+        top = np.argsort(probs)[::-1][: args.top]
+        names = (
+            [imagenet_label_name(i) for i in top]
+            if args.num_classes == 1000 else [str(i) for i in top]
+        )
+        print(f"{path}:")
+        for i, name in zip(top, names):
+            print(f"  {probs[i]:6.2%}  {name}")
+
+
+def cmd_detect(args):
+    from deepvision_tpu.data.metadata import class_names
+    from deepvision_tpu.ops.yolo_postprocess import yolo_postprocess
+
+    names = class_names(args.names)
+    img = load_image(args.images[0], args.size, scale="tanh")
+    state = load_state(args.model, args.workdir, img,
+                       num_classes=len(names))
+    preds = _apply(state, img)
+    boxes, scores, classes, valid = yolo_postprocess(
+        preds, len(names), score_thresh=args.score
+    )
+    boxes = np.asarray(boxes)[0] * args.size  # corners (x1,y1,x2,y2)
+    scores, classes = np.asarray(scores)[0], np.asarray(classes)[0]
+    valid = np.asarray(valid)[0]
+    canvas = np.clip((img[0] + 1) * 127.5, 0, 255).astype(np.uint8)
+    kept = 0
+    for box, score, cls, ok in zip(boxes, scores, classes, valid):
+        if not ok:
+            continue
+        x1, y1, x2, y2 = box
+        color = _PALETTE[int(cls) % len(_PALETTE)]
+        draw_box(canvas, x1, y1, x2, y2, color)
+        print(f"  {names[int(cls)]}: {score:.2f} at "
+              f"({x1:.0f},{y1:.0f})-({x2:.0f},{y2:.0f})")
+        kept += 1
+    print(f"{kept} detections ≥ {args.score}")
+    save_image(args.output, canvas)
+
+
+def cmd_pose(args):
+    img = load_image(args.images[0], args.size, scale="tanh")
+    state = load_state(args.model, args.workdir, img, num_heatmaps=16)
+    heatmaps = np.asarray(_apply(state, img)[-1])[0]  # last stack
+    canvas = np.clip((img[0] + 1) * 127.5, 0, 255).astype(np.uint8)
+    g = heatmaps.shape[0]
+    for j in range(heatmaps.shape[-1]):
+        hm = heatmaps[..., j]
+        y, x = np.unravel_index(np.argmax(hm), hm.shape)
+        if hm[y, x] <= args.score:
+            continue
+        draw_dot(canvas, x * args.size / g, y * args.size / g,
+                 _PALETTE[j % len(_PALETTE)])
+        print(f"  joint {j}: ({x}, {y}) conf {hm[y, x]:.2f}")
+    save_image(args.output, canvas)
+
+
+def cmd_dcgan(args):
+    import jax
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.gan import create_dcgan_state, dcgan_sample
+
+    state = create_dcgan_state(
+        get_model("dcgan_generator"), get_model("dcgan_discriminator")
+    )
+    ckpt = Path(f"{args.workdir}/ckpt")
+    if ckpt.exists():
+        mgr = CheckpointManager(ckpt)
+        if mgr.latest_epoch() is not None:
+            state, meta = mgr.restore(state)
+            print(f"restored epoch {meta['epoch']}")
+        mgr.close()
+    n = args.n
+    samples = np.asarray(dcgan_sample(state, jax.random.key(args.seed), n))
+    side = int(np.ceil(np.sqrt(n)))
+    grid = np.full((side * 28, side * 28, 1), -1.0, np.float32)
+    for i in range(n):
+        r, c = divmod(i, side)
+        grid[r * 28:(r + 1) * 28, c * 28:(c + 1) * 28] = samples[i]
+    save_image(args.output, grid)
+
+
+def cmd_cyclegan(args):
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.gan import (
+        create_cyclegan_state,
+        cyclegan_translate,
+    )
+
+    img = load_image(args.images[0], args.size, scale="tanh")
+    state = create_cyclegan_state(
+        get_model("cyclegan_generator"),
+        get_model("cyclegan_discriminator"),
+        image_size=args.size,
+    )
+    ckpt = Path(f"{args.workdir}/ckpt")
+    if ckpt.exists():
+        mgr = CheckpointManager(ckpt)
+        if mgr.latest_epoch() is not None:
+            state, meta = mgr.restore(state)
+            print(f"restored epoch {meta['epoch']}")
+        mgr.close()
+    out = np.asarray(cyclegan_translate(state, img, args.direction))[0]
+    save_image(args.output, out)
+
+
+def cmd_export(args):
+    from deepvision_tpu.export import export_forward, save_exported
+
+    size = 299 if args.model == "inception3" else 224
+    sample = np.zeros((1, size, size, 3), np.float32)
+    state = load_state(args.model, args.workdir, sample,
+                       num_classes=args.num_classes)
+    variables = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    data = export_forward(state.apply_fn, variables, sample)
+    out = args.output or f"{args.model}.stablehlo"
+    save_exported(out, data)
+    print(f"exported {len(data)/1e6:.1f} MB StableHLO artifact to {out}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp, model=None, images=True, output=None):
+        if model:
+            sp.add_argument("-m", "--model", default=model)
+        sp.add_argument("--workdir", default=None)
+        if images:
+            sp.add_argument("images", nargs="+")
+        if output:
+            sp.add_argument("-o", "--output", default=output)
+
+    sp = sub.add_parser("classify")
+    common(sp, model="resnet50")
+    sp.add_argument("--top", type=int, default=5)
+    sp.add_argument("--num-classes", type=int, default=1000)
+    sp.set_defaults(fn=cmd_classify)
+
+    sp = sub.add_parser("detect")
+    common(sp, model="yolov3", output="detections.png")
+    sp.add_argument("--names", default="voc", choices=["voc", "mscoco"])
+    sp.add_argument("--size", type=int, default=416)
+    sp.add_argument("--score", type=float, default=0.5)
+    sp.set_defaults(fn=cmd_detect)
+
+    sp = sub.add_parser("pose")
+    common(sp, model="hourglass104", output="pose.png")
+    sp.add_argument("--size", type=int, default=256)
+    sp.add_argument("--score", type=float, default=0.1)
+    sp.set_defaults(fn=cmd_pose)
+
+    sp = sub.add_parser("dcgan")
+    common(sp, images=False, output="samples.png")
+    sp.add_argument("-n", type=int, default=16)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_dcgan)
+
+    sp = sub.add_parser("cyclegan")
+    common(sp, output="translated.png")
+    sp.add_argument("--direction", default="a2b", choices=["a2b", "b2a"])
+    sp.add_argument("--size", type=int, default=256)
+    sp.set_defaults(fn=cmd_cyclegan)
+
+    sp = sub.add_parser("export")
+    common(sp, model="resnet50", images=False)
+    sp.add_argument("-o", "--output", default=None)
+    sp.add_argument("--num-classes", type=int, default=1000)
+    sp.set_defaults(fn=cmd_export)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+"""Inference surface: metadata loaders, StableHLO export round-trip, and
+the predict.py subcommands (the reference's notebook/demo capability —
+ref: YOLO/tensorflow/demo_mscoco.ipynb, DCGAN/tensorflow/inference.py,
+CycleGAN/tensorflow/inference.py + convert.py).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+# ------------------------------------------------------------ metadata
+
+
+def test_imagenet_metadata():
+    from deepvision_tpu.data.metadata import (
+        imagenet_label_name,
+        imagenet_synsets,
+        imagenet_val_synsets,
+        imagenet_wnid_to_index,
+    )
+
+    syn = imagenet_synsets()
+    assert len(syn) == 1000
+    assert syn[0][0] == "n01440764"
+    assert "tench" in imagenet_label_name(0)
+    assert imagenet_wnid_to_index()["n01440764"] == 0
+    assert len(imagenet_val_synsets()) == 50_000
+
+
+def test_class_names():
+    from deepvision_tpu.data.metadata import class_names
+
+    assert len(class_names("voc")) == 20
+    assert len(class_names("mscoco")) == 80
+    assert class_names("voc")[0] == "aeroplane"
+
+
+# -------------------------------------------------------------- export
+
+
+def test_export_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    import optax
+
+    from deepvision_tpu.export import (
+        export_forward,
+        load_exported,
+        save_exported,
+    )
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.state import create_train_state
+
+    sample = np.random.default_rng(0).normal(
+        size=(1, 32, 32, 1)
+    ).astype(np.float32)
+    model = get_model("lenet5", num_classes=10)
+    state = create_train_state(model, optax.sgd(0.1), sample)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    data = export_forward(state.apply_fn, variables, sample)
+    path = save_exported(tmp_path / "lenet5.stablehlo", data)
+    fn = load_exported(path)
+    got = np.asarray(fn(sample))
+    want = np.asarray(
+        state.apply_fn(variables, sample, train=False)
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+# ------------------------------------------------------------- predict
+
+
+def _write_test_image(path, size=64):
+    import tensorflow as tf
+
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+    tf.io.write_file(str(path), tf.io.encode_jpeg(tf.constant(arr)))
+
+
+def test_predict_classify_runs(tmp_path, capsys):
+    import predict
+
+    img = tmp_path / "img.jpg"
+    _write_test_image(img)
+    predict.main([
+        "classify", "-m", "lenet5", str(img), "--num-classes", "10",
+    ])
+    out = capsys.readouterr().out
+    assert "freshly initialized" in out
+    assert "%" in out
+
+
+def test_predict_detect_draws(tmp_path, capsys):
+    import predict
+
+    img = tmp_path / "img.jpg"
+    out_png = tmp_path / "out.png"
+    _write_test_image(img, size=128)
+    predict.main([
+        "detect", str(img), "-o", str(out_png), "--size", "128",
+        "--score", "0.0",
+    ])
+    assert out_png.exists()
+    assert "detections" in capsys.readouterr().out
+
+
+def test_predict_dcgan_grid(tmp_path):
+    import predict
+
+    out_png = tmp_path / "samples.png"
+    predict.main(["dcgan", "-o", str(out_png), "-n", "4"])
+    assert out_png.exists()
+
+
+def test_predict_export_cli(tmp_path, capsys):
+    import predict
+
+    out = tmp_path / "lenet5.stablehlo"
+    predict.main([
+        "export", "-m", "lenet5", "-o", str(out), "--num-classes", "10",
+    ])
+    assert out.exists() and out.stat().st_size > 0
+    assert "exported" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------- L4 tooling
+
+
+def test_imagenet_bbox_xml_to_csv(tmp_path):
+    """XML walk → normalized clamped CSV (ref:
+    Datasets/ILSVRC2012/process_bounding_boxes.py capability)."""
+    from deepvision_tpu.data.builders.imagenet_bbox import (
+        parse_annotation_xml,
+        process_bounding_boxes,
+    )
+
+    syn = tmp_path / "ann" / "n01440764"
+    syn.mkdir(parents=True)
+    xml = """<annotation><filename>n01440764_18</filename>
+      <size><width>500</width><height>375</height></size>
+      <object><bndbox><xmin>50</xmin><ymin>75</ymin>
+              <xmax>450</xmax><ymax>700</ymax></bndbox></object>
+      <object><bndbox><xmin>600</xmin><ymin>10</ymin>
+              <xmax>650</xmax><ymax>20</ymax></bndbox></object>
+    </annotation>"""
+    (syn / "n01440764_18.xml").write_text(xml)
+    boxes = parse_annotation_xml(syn / "n01440764_18.xml")
+    # box 1: normalized + ymax clamped to 1; box 2: degenerate (xmin>1
+    # after clamp) and dropped
+    assert len(boxes) == 1
+    name, (xmin, ymin, xmax, ymax) = boxes[0]
+    assert name == "n01440764_18.JPEG"
+    assert (xmin, ymin) == (50 / 500, 75 / 375)
+    assert (xmax, ymax) == (450 / 500, 1.0)
+
+    out = tmp_path / "boxes.csv"
+    n = process_bounding_boxes(tmp_path / "ann", out)
+    assert n == 1
+    line = out.read_text().strip()
+    assert line == "n01440764_18.JPEG,0.1000,0.2000,0.9000,1.0000"
+    # synset filter excludes everything
+    assert process_bounding_boxes(tmp_path / "ann", out,
+                                  synsets={"n99999999"}) == 0
+
+
+def test_publish_gracefully_skips_without_gcs(tmp_path, capsys, monkeypatch):
+    import builtins
+
+    from deepvision_tpu.train import publish
+
+    real_import = builtins.__import__
+
+    def no_gcs(name, *a, **kw):
+        if name.startswith("google"):
+            raise ImportError(name)
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", no_gcs)
+    assert publish.publish_to_gcs(tmp_path, "bucket", "dir") is None
+    assert "skipping upload" in capsys.readouterr().out

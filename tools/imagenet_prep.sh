@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# ImageNet raw-download preparation (VERDICT §2 item 38).
+# Capability parity with ref: Datasets/ILSVRC2012/{untar,flatten,
+# flatten-val}-script.sh + DATASET.md:73-118 — unpack the per-synset
+# train tars and flatten train/val into the single directories
+# data/folder.py's loader expects (synset-prefixed filenames).
+#
+# Usage:
+#   imagenet_prep.sh untar   <dir-with-per-synset-tars>
+#   imagenet_prep.sh flatten <train-dir> <out-dir>
+#   imagenet_prep.sh flatten-val <val-dir> <out-dir> <val-labels-file>
+#     (val-labels-file: 50k ground-truth synsets in file order —
+#      deepvision_tpu/data/assets/imagenet_val_labels.txt)
+set -euo pipefail
+
+cmd=${1:?usage: imagenet_prep.sh untar|flatten|flatten-val ...}
+
+case "$cmd" in
+  untar)
+    dir=${2:?need dir with nXXXXXXXX.tar files}
+    cd "$dir"
+    for a in *.tar; do
+      b=${a%.tar}
+      mkdir -p "$b"
+      tar xf "$a" -C "$b"
+    done
+    ;;
+  flatten)
+    src=${2:?need train dir}; out=${3:?need output dir}
+    mkdir -p "$out"
+    # files are already synset-prefixed (nXXXXXXXX_YYYY.JPEG)
+    find "$src" -mindepth 2 -type f -exec cp -t "$out" '{}' +
+    ;;
+  flatten-val)
+    src=${2:?need val dir}; out=${3:?need output dir}
+    labels=${4:?need val-labels file}
+    mkdir -p "$out"
+    # rename ILSVRC2012_val_NNNNNNNN.JPEG -> <synset>_NNNNNNNN.JPEG so the
+    # folder loader can parse the label from the filename
+    i=0
+    find "$src" -maxdepth 1 -type f -name '*.JPEG' | sort | while read -r f; do
+      i=$((i + 1))
+      syn=$(sed -n "${i}p" "$labels")
+      cp "$f" "$out/${syn}_$(basename "$f" | grep -o '[0-9]*\.JPEG')"
+    done
+    ;;
+  *)
+    echo "unknown command: $cmd" >&2; exit 2;;
+esac
+echo "done: $cmd"

@@ -37,6 +37,11 @@ def parse_args():
                    help="synthetic dataset size when no --data-dir")
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="train steps per epoch (detection datasets)")
+    p.add_argument("--output-bucket", default=None,
+                   help="GCS bucket to publish the final checkpoint to "
+                        "(ref: Hourglass/tensorflow/main.py:50-65)")
+    p.add_argument("--output-dir", default=None,
+                   help="GCS object prefix within --output-bucket")
     return p.parse_args()
 
 
@@ -197,6 +202,15 @@ def main():
         trainer.resume(args.checkpoint)
         print(f"resumed at epoch {trainer.start_epoch}")
     trainer.fit(args.epochs)
+    _maybe_publish(args, f"{args.workdir}/{args.model}/ckpt")
+
+
+def _maybe_publish(args, ckpt_dir: str):
+    if not (args.output_bucket and args.output_dir):
+        return
+    from deepvision_tpu.train.publish import publish_to_gcs
+
+    publish_to_gcs(ckpt_dir, args.output_bucket, args.output_dir)
 
 
 def run_gan(args, cfg, dtype):
@@ -289,6 +303,7 @@ def run_gan(args, cfg, dtype):
         resume=args.resume or args.checkpoint is not None,
         resume_epoch=args.checkpoint,
     )
+    _maybe_publish(args, f"{workdir}/ckpt")
 
 
 if __name__ == "__main__":
