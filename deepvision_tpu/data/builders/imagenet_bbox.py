@@ -22,21 +22,29 @@ def parse_annotation_xml(path: str | Path) -> list[tuple[str, list[float]]]:
     """One annotation file -> [(filename.JPEG, [xmin,ymin,xmax,ymax]), ...]
     with coordinates normalized by the annotator's displayed size and
     clamped to [0, 1]."""
-    root = ET.parse(path).getroot()
-    filename = root.findtext("filename", Path(path).stem)
-    if not filename.endswith(".JPEG"):
-        filename += ".JPEG"
-    width = float(root.findtext("size/width"))
-    height = float(root.findtext("size/height"))
+    try:
+        root = ET.parse(path).getroot()
+        filename = root.findtext("filename", Path(path).stem)
+        if not filename.endswith(".JPEG"):
+            filename += ".JPEG"
+        width = float(root.findtext("size/width") or 0)
+        height = float(root.findtext("size/height") or 0)
+        if width <= 0 or height <= 0:
+            return []  # malformed annotator size — tolerate, like the ref
+    except (ET.ParseError, TypeError, ValueError):
+        return []
     out = []
     for obj in root.iter("object"):
         box = obj.find("bndbox")
         if box is None:
             continue
-        xmin = min(max(float(box.findtext("xmin")) / width, 0.0), 1.0)
-        ymin = min(max(float(box.findtext("ymin")) / height, 0.0), 1.0)
-        xmax = min(max(float(box.findtext("xmax")) / width, 0.0), 1.0)
-        ymax = min(max(float(box.findtext("ymax")) / height, 0.0), 1.0)
+        try:
+            xmin = min(max(float(box.findtext("xmin")) / width, 0.0), 1.0)
+            ymin = min(max(float(box.findtext("ymin")) / height, 0.0), 1.0)
+            xmax = min(max(float(box.findtext("xmax")) / width, 0.0), 1.0)
+            ymax = min(max(float(box.findtext("ymax")) / height, 0.0), 1.0)
+        except (TypeError, ValueError):
+            continue
         if xmin >= xmax or ymin >= ymax:
             continue  # degenerate after clamping
         out.append((filename, [xmin, ymin, xmax, ymax]))

@@ -24,8 +24,28 @@ def local_response_norm(
     alpha: float = 1e-4,
     beta: float = 0.75,
     k: float = 2.0,
+    impl: str | None = None,
 ) -> jax.Array:
-    """NHWC input; normalizes over the trailing channel axis."""
+    """NHWC input; normalizes over the trailing channel axis.
+
+    On a single-device TPU backend this dispatches to the fused Pallas
+    kernel (ops/lrn_pallas.py — one VMEM-resident pass instead of XLA's
+    reduce_window + elementwise chain). Multi-device stays on the jnp
+    lowering: a ``pallas_call`` has no GSPMD partitioning rule, so under
+    a sharded jit it would force a gather. ``impl`` overrides the
+    dispatch ("jnp" | "pallas"); both paths are parity-pinned by
+    tests/test_ops.py.
+    """
+    if impl is None:
+        impl = (
+            "pallas"
+            if jax.default_backend() == "tpu" and jax.device_count() == 1
+            else "jnp"
+        )
+    if impl == "pallas":
+        from deepvision_tpu.ops.lrn_pallas import local_response_norm_pallas
+
+        return local_response_norm_pallas(x, size, alpha, beta, k)
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     sq = x32 * x32

@@ -52,6 +52,7 @@ class Trainer:
         eval_step=classification_eval_step,
         log_every: int = 10,
         seed: int = 0,
+        check_numerics: bool = False,
     ):
         self.model = model
         self.config = config
@@ -69,7 +70,12 @@ class Trainer:
             (1, size, size, config.get("channels", 3)), np.float32
         )
         self.state = create_train_state(model, self.tx, sample, rng=seed)
-        self._train_step = compile_train_step(train_step, mesh)
+        if check_numerics:  # NaN/Inf tripwire (SURVEY §5.2)
+            from deepvision_tpu.core.step import compile_checked_train_step
+
+            self._train_step = compile_checked_train_step(train_step, mesh)
+        else:
+            self._train_step = compile_train_step(train_step, mesh)
         self._eval_step = compile_eval_step(eval_step, mesh)
         self.loggers = Loggers()
         self.tb = TensorBoardWriter(self.workdir / "tb")

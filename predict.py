@@ -45,6 +45,8 @@ def load_image(path: str, size: int, *, scale: str) -> np.ndarray:
         from deepvision_tpu.ops.normalize import IMAGENET_CHANNEL_MEANS
 
         img = img - np.asarray(IMAGENET_CHANNEL_MEANS, np.float32)
+    elif scale == "unit":  # [0,1] (the MNIST-family loaders)
+        img = img / 255.0
     else:
         img = img / 127.5 - 1.0
     return img[None]
@@ -123,11 +125,23 @@ def _apply(state, images):
 # --------------------------------------------------------- subcommands
 
 
+def _model_geometry(model_name: str) -> tuple[int, int]:
+    """(input_size, channels) from the model's training config so restored
+    checkpoints see the shapes they were trained with."""
+    from deepvision_tpu.train.configs import TRAINING_CONFIG
+
+    cfg = TRAINING_CONFIG.get(model_name, {})
+    return cfg.get("input_size", 224), cfg.get("channels", 3)
+
+
 def cmd_classify(args):
     from deepvision_tpu.data.metadata import imagenet_label_name
 
-    size = 299 if args.model == "inception3" else 224
-    imgs = [load_image(p, size, scale="imagenet") for p in args.images]
+    size, channels = _model_geometry(args.model)
+    scale = "unit" if channels == 1 else "imagenet"
+    imgs = [load_image(p, size, scale=scale) for p in args.images]
+    if channels == 1:  # grayscale nets (lenet5)
+        imgs = [img.mean(axis=-1, keepdims=True) for img in imgs]
     state = load_state(args.model, args.workdir, imgs[0],
                        num_classes=args.num_classes)
     for path, img in zip(args.images, imgs):
@@ -248,8 +262,8 @@ def cmd_cyclegan(args):
 def cmd_export(args):
     from deepvision_tpu.export import export_forward, save_exported
 
-    size = 299 if args.model == "inception3" else 224
-    sample = np.zeros((1, size, size, 3), np.float32)
+    size, channels = _model_geometry(args.model)
+    sample = np.zeros((1, size, size, channels), np.float32)
     state = load_state(args.model, args.workdir, sample,
                        num_classes=args.num_classes)
     variables = {"params": state.params}

@@ -38,3 +38,28 @@ def test_keyseq_unique():
     seq = KeySeq(0)
     a, b = next(seq), next(seq)
     assert not np.array_equal(jax.random.key_data(a), jax.random.key_data(b))
+
+
+def test_checked_step_catches_nan(mesh8):
+    """compile_checked_train_step (SURVEY §5.2): a NaN produced inside
+    the compiled step raises instead of silently corrupting training."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from deepvision_tpu.core.step import compile_checked_train_step
+
+    def bad_step(state, batch, key):
+        loss = jnp.log(batch["image"]).mean()  # log(-1) -> NaN
+        return state + 1, {"loss": loss}
+
+    step = compile_checked_train_step(bad_step, mesh8)
+    import numpy as np
+
+    good = {"image": np.full((8, 4), 2.0, np.float32)}
+    state, metrics = step(jnp.zeros(()), good, jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+
+    bad = {"image": np.full((8, 4), -1.0, np.float32)}
+    with pytest.raises(Exception, match="nan"):
+        step(jnp.zeros(()), bad, jax.random.key(0))

@@ -36,11 +36,10 @@ case "$cmd" in
     labels=${4:?need val-labels file}
     mkdir -p "$out"
     # rename ILSVRC2012_val_NNNNNNNN.JPEG -> <synset>_NNNNNNNN.JPEG so the
-    # folder loader can parse the label from the filename
-    i=0
-    find "$src" -maxdepth 1 -type f -name '*.JPEG' | sort | while read -r f; do
-      i=$((i + 1))
-      syn=$(sed -n "${i}p" "$labels")
+    # folder loader can parse the label from the filename; single pass
+    # over both streams (no per-file sed rescans)
+    paste -d' ' <(find "$src" -maxdepth 1 -type f -name '*.JPEG' | sort) \
+                "$labels" | while read -r f syn; do
       cp "$f" "$out/${syn}_$(basename "$f" | grep -o '[0-9]*\.JPEG')"
     done
     ;;

@@ -42,6 +42,9 @@ def parse_args():
                         "(ref: Hourglass/tensorflow/main.py:50-65)")
     p.add_argument("--output-dir", default=None,
                    help="GCS object prefix within --output-bucket")
+    p.add_argument("--check-numerics", action="store_true",
+                   help="run the train step under checkify float checks "
+                        "(NaN/Inf raise with the failing op; ~2x slower)")
     return p.parse_args()
 
 
@@ -196,7 +199,8 @@ def main():
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     trainer = Trainer(
         model, cfg, mesh, train_data, val_data,
-        workdir=args.workdir, steps_per_epoch=steps, **step_fns,
+        workdir=args.workdir, steps_per_epoch=steps,
+        check_numerics=args.check_numerics, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
@@ -208,9 +212,18 @@ def main():
 def _maybe_publish(args, ckpt_dir: str):
     if not (args.output_bucket and args.output_dir):
         return
+    from pathlib import Path
+
     from deepvision_tpu.train.publish import publish_to_gcs
 
-    publish_to_gcs(ckpt_dir, args.output_bucket, args.output_dir)
+    # publish only the newest retained epoch, not the whole manager tree
+    root = Path(ckpt_dir)
+    epochs = sorted(
+        (p for p in root.iterdir() if p.name.isdigit()),
+        key=lambda p: int(p.name),
+    )
+    target = epochs[-1] if epochs else root
+    publish_to_gcs(target, args.output_bucket, args.output_dir)
 
 
 def run_gan(args, cfg, dtype):
