@@ -156,19 +156,41 @@ def make_imagenet_data(
     device — ops/normalize.py; <0.5-LSB rounding vs the reference's f32
     path); validation stays f32 for exact preprocessing parity.
     """
+    import jax
+
     d = Path(data_dir)
-    steps = train_images // batch_size
+    steps = train_images // batch_size  # batch_size is the GLOBAL batch
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    if batch_size % nproc:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by "
+            f"{nproc} processes"
+        )
+    local_bs = batch_size // nproc
 
     def train_data(epoch: int):
-        ds = make_dataset(str(d / "train-*"), batch_size, size,
-                          is_training=True, as_uint8=train_as_uint8)
+        # Multi-host (train_dist.py): each process reads a DISJOINT file
+        # shard and batches its local share; core.shard_batch assembles
+        # the locals into the global array (local × nproc = global).
+        ds = make_dataset(str(d / "train-*"), local_bs, size,
+                          is_training=True, as_uint8=train_as_uint8,
+                          num_process=nproc, process_index=pid)
         return _as_batches(ds, steps)
 
     def val_data():
-        # No step limit: the non-repeating eval dataset ends naturally, and
-        # the final partial batch is padded + masked (full 50k coverage).
+        # Validation must NOT file-shard per process: uneven shard sizes
+        # would give processes different batch counts and deadlock the
+        # collective eval step. Every process streams the SAME full set
+        # at the global batch size and slices its own row block — batch
+        # counts always agree, coverage stays exact (final partial batch
+        # padded + masked).
         ds = make_dataset(str(d / "validation-*"), batch_size, size,
                           is_training=False)
-        return _as_batches(ds, pad_to=batch_size)
+        for batch in _as_batches(ds, pad_to=batch_size):
+            yield {
+                k: v[pid * local_bs:(pid + 1) * local_bs]
+                for k, v in batch.items()
+            }
 
     return train_data, val_data, steps

@@ -1,7 +1,13 @@
 """ResNet family — the framework's flagship (north-star config).
 
 V1 variants re-express the PyTorch reference:
-- ResNet-34: BasicBlock stacks (3,4,6,3) — ref: ResNet/pytorch/models/resnet34.py:8-143.
+- ResNet-34: BasicBlock stacks (3,4,6,3). NOTE a reference defect found in
+  round 2: the ref's shipped resnet34.py builds (2,2,2,2) stacks — an
+  18-layer topology (11.69M params) contradicting its own "34-layer
+  column" comment (ref: resnet34.py:38-41) and its committed log's
+  23.38M-param summary. We implement the paper's 34-layer depth (and keep
+  the ref's projection quirk below); param counts pinned in
+  tests/test_models_classification.py.
 - ResNet-50: BottleneckBlock 1x1-3x3-1x1 stacks (3,4,6,3) —
   ref: ResNet/pytorch/models/resnet50.py:8-165.
 - ResNet-152: same with (3,8,36,3) — ref: ResNet/pytorch/models/resnet152.py:38-39.

@@ -91,9 +91,12 @@ def _fwd(x, size, alpha, beta, k, interpret):
     return _lrn_forward(x, size, alpha, beta, k, interpret), x
 
 
-def _window_sum(v, size):
+def _window_sum(v, size, *, mirrored: bool = False):
+    """Channel-window sum with torch centering; ``mirrored`` swaps the
+    padding offsets (the adjoint window used by the backward pass)."""
     half = size // 2
-    pad = [(0, 0)] * (v.ndim - 1) + [(half, size - 1 - half)]
+    lo, hi = (size - 1 - half, half) if mirrored else (half, size - 1 - half)
+    pad = [(0, 0)] * (v.ndim - 1) + [(lo, hi)]
     return jax.lax.reduce_window(
         v, 0.0, jax.lax.add,
         window_dimensions=[1] * (v.ndim - 1) + [size],
@@ -111,16 +114,8 @@ def _bwd(size, alpha, beta, k, interpret, x, g):
     d = k + (alpha / size) * _window_sum(x32 * x32, size)
     d_mb = jnp.exp(-beta * jnp.log(d))
     inner = g32 * x32 * d_mb / d
-    # adjoint of the (half-left, size-1-half-right) window is the window
-    # with mirrored padding
-    half = size // 2
-    pad = [(0, 0)] * (x.ndim - 1) + [(size - 1 - half, half)]
-    adj = jax.lax.reduce_window(
-        inner, 0.0, jax.lax.add,
-        window_dimensions=[1] * (x.ndim - 1) + [size],
-        window_strides=[1] * x.ndim,
-        padding=pad,
-    )
+    # adjoint of the forward window = the same window with mirrored padding
+    adj = _window_sum(inner, size, mirrored=True)
     dx = g32 * d_mb - (2.0 * alpha * beta / size) * x32 * adj
     return (dx.astype(x.dtype),)
 

@@ -1,0 +1,16 @@
+"""Explicit-collective parallelism patterns (shard_map / ppermute).
+
+The framework's default scaling path is GSPMD: annotate shardings, let
+XLA insert collectives (core/mesh.py, core/step.py). This package holds
+the EXPLICIT versions of those patterns for the cases where manual
+scheduling matters — ring halo exchange for spatially-partitioned
+convolutions (the CNN analog of ring attention's neighbor exchange over
+ICI; SURVEY §5.7), written with ``jax.shard_map`` + ``lax.ppermute``.
+"""
+
+from deepvision_tpu.parallel.spatial import (
+    halo_exchange,
+    spatial_conv2d,
+)
+
+__all__ = ["halo_exchange", "spatial_conv2d"]

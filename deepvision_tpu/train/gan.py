@@ -421,13 +421,17 @@ def fit_gan(
     log_every: int = 50,
     resume: bool = False,
     resume_epoch: int | None = None,
+    check_numerics: bool = False,
 ):
     """Minimal GAN epoch loop: compiled step + loggers + TB + Orbax saves
     every ``save_every`` epochs keeping 3 (ref: DCGAN/tensorflow/main.py:39,
     80-83; CycleGAN saves every epoch with the epoch tracked in the
     checkpoint, ref: train.py:329-333 — pass save_every=1)."""
     from deepvision_tpu.core import shard_batch
-    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.core.step import (
+        compile_checked_train_step,
+        compile_train_step,
+    )
     from deepvision_tpu.train.checkpoint import CheckpointManager
     from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
 
@@ -440,7 +444,10 @@ def fit_gan(
         start_epoch = meta["epoch"] + 1
         if meta.get("loggers"):
             loggers = meta["loggers"]
-    step = compile_train_step(train_step, mesh)
+    compiler = (
+        compile_checked_train_step if check_numerics else compile_train_step
+    )
+    step = compiler(train_step, mesh)
     key = jax.random.key(np.uint32(1234))
     for epoch in range(start_epoch, epochs):
         t0 = time.time()

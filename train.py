@@ -315,6 +315,7 @@ def run_gan(args, cfg, dtype):
         save_every=cfg.get("save_every", 2),
         resume=args.resume or args.checkpoint is not None,
         resume_epoch=args.checkpoint,
+        check_numerics=args.check_numerics,
     )
     _maybe_publish(args, f"{workdir}/ckpt")
 
