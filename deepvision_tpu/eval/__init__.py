@@ -1,0 +1,11 @@
+"""Evaluation metrics the reference never finished.
+
+- detection mAP (the reference's README lists it as "working in
+  progress", ref: YOLO/tensorflow/README.md:28) — eval/detection.py
+- pose PCK/PCKh (never reported by the reference) — eval/pose.py
+"""
+
+from deepvision_tpu.eval.detection import average_precision, evaluate_map
+from deepvision_tpu.eval.pose import pck
+
+__all__ = ["average_precision", "evaluate_map", "pck"]

@@ -1,0 +1,53 @@
+"""Pose PCK / PCKh — the accuracy metric the reference never reported
+(SURVEY §6: "Hourglass PCKh ... not reported").
+
+PCK@τ: a predicted keypoint is correct when its distance to the ground
+truth is < τ × a per-sample normalization length — the MPII convention
+uses the head-segment size (PCKh, τ = 0.5); with only the person scale
+available, ``scale × 200`` (the MPII body height) times a head fraction
+is the standard fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pck(
+    pred_xy: np.ndarray,
+    true_xy: np.ndarray,
+    visible: np.ndarray,
+    norm_length: np.ndarray,
+    *,
+    threshold: float = 0.5,
+) -> dict:
+    """(B, K, 2) predicted + true coords (any consistent units),
+    (B, K) visibility, (B,) per-sample normalization length →
+    {'pck': scalar, 'per_joint': (K,), 'count': (K,)} over visible
+    joints only.
+    """
+    pred_xy = np.asarray(pred_xy, np.float64)
+    true_xy = np.asarray(true_xy, np.float64)
+    vis = np.asarray(visible) > 0
+    norm = np.asarray(norm_length, np.float64)[:, None]
+    dist = np.linalg.norm(pred_xy - true_xy, axis=-1)  # (B, K)
+    correct = (dist < threshold * np.maximum(norm, 1e-12)) & vis
+    count = vis.sum(axis=0)
+    per_joint = np.where(
+        count > 0, correct.sum(axis=0) / np.maximum(count, 1), np.nan
+    )
+    total_vis = vis.sum()
+    return {
+        "pck": float(correct.sum() / total_vis) if total_vis else 0.0,
+        "per_joint": per_joint,
+        "count": count,
+    }
+
+
+def heatmap_argmax_keypoints(heatmaps: np.ndarray) -> np.ndarray:
+    """(B, H, W, K) heatmaps → (B, K, 2) (x, y) peak coordinates in
+    heatmap cells (the decoding the demo/eval path uses)."""
+    b, h, w, k = heatmaps.shape
+    flat = heatmaps.reshape(b, h * w, k).argmax(axis=1)  # (B, K)
+    ys, xs = np.divmod(flat, w)
+    return np.stack([xs, ys], axis=-1).astype(np.float64)

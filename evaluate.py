@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Offline evaluation CLI: detection mAP and pose PCK.
+
+Completes the evaluation surface the reference never shipped (mAP is
+explicitly WIP there, ref: YOLO/tensorflow/README.md:28; PCKh is never
+reported). Classification top-1/5 already comes from ``train.py``'s
+exact masked validation pass.
+
+    evaluate.py detection -m yolov3 --workdir runs/yolov3 --data-dir /data/voc
+    evaluate.py pose -m hourglass104 --workdir runs/hourglass104 --data-dir /data/mpii
+
+Without --data-dir both commands run on the synthetic sets (hermetic
+smoke — the same data the synthetic trainers use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def _load(model_name, workdir, sample, **kw):
+    import predict
+
+    return predict.load_state(model_name, workdir, sample, **kw)
+
+
+def _apply(state, images):
+    from predict import _apply as apply_fn  # one shared eval-apply impl
+
+    return apply_fn(state, images)
+
+
+def cmd_detection(args):
+    from deepvision_tpu.data.metadata import class_names
+    from deepvision_tpu.eval import evaluate_map
+    from deepvision_tpu.ops.iou import xywh_to_corners
+    from deepvision_tpu.ops.yolo_postprocess import yolo_postprocess
+
+    names = class_names(args.names)
+    num_classes = len(names)
+    size = args.size
+
+    if args.data_dir:
+        from deepvision_tpu.data.detection import make_detection_dataset
+        from deepvision_tpu.data.padding import iter_tf_batches
+
+        ds = make_detection_dataset(
+            f"{args.data_dir}/{args.split}-*", args.batch_size, size,
+            is_training=False,
+        )
+        batches = iter_tf_batches(ds, ("image", "boxes", "label"))
+    else:
+        from deepvision_tpu.data.detection import (
+            synthetic_batches,
+            synthetic_detection,
+        )
+
+        size = min(size, 128)
+        imgs, boxes, labels = synthetic_detection(
+            64, size=size, num_classes=num_classes
+        )
+        batches = synthetic_batches(imgs, boxes, labels, args.batch_size)
+
+    state = None
+    dets, gts = [], []
+    for batch in batches:
+        if state is None:
+            state = _load(args.model, args.workdir, batch["image"][:1],
+                          num_classes=num_classes)
+        preds = _apply(state, batch["image"])
+        b_boxes, b_scores, b_cls, b_valid = yolo_postprocess(
+            preds, num_classes, score_thresh=args.score
+        )
+        b_boxes = np.asarray(b_boxes)
+        b_scores, b_cls = np.asarray(b_scores), np.asarray(b_cls)
+        b_valid = np.asarray(b_valid).astype(bool)
+        for i in range(len(b_boxes)):
+            keep = b_valid[i]
+            dets.append({
+                "boxes": b_boxes[i][keep],
+                "scores": b_scores[i][keep],
+                "classes": b_cls[i][keep],
+            })
+            gt_keep = batch["label"][i] >= 0
+            gts.append({
+                "boxes": np.asarray(
+                    xywh_to_corners(batch["boxes"][i][gt_keep])
+                ),
+                "classes": batch["label"][i][gt_keep],
+            })
+    out = evaluate_map(dets, gts, num_classes,
+                       iou_thresh=args.iou, method=args.ap_method)
+    per_class = {
+        names[c]: round(float(out["ap"][c]), 4)
+        for c in range(num_classes) if np.isfinite(out["ap"][c])
+    }
+    print(json.dumps({
+        "metric": "mAP", "iou": args.iou, "value": round(out["map"], 4),
+        "images": len(dets), "per_class": per_class,
+    }))
+
+
+def cmd_pose(args):
+    from deepvision_tpu.eval import pck
+    from deepvision_tpu.eval.pose import heatmap_argmax_keypoints
+
+    size = args.size
+    if args.data_dir:
+        from deepvision_tpu.data.padding import iter_tf_batches
+        from deepvision_tpu.data.pose import make_pose_dataset
+
+        ds = make_pose_dataset(
+            f"{args.data_dir}/{args.split}-*", args.batch_size, size,
+            is_training=False,
+        )
+        batches = iter_tf_batches(ds, ("image", "kx", "ky", "v"))
+    else:
+        from deepvision_tpu.data.pose import (
+            synthetic_pose,
+            synthetic_pose_batches,
+        )
+
+        size = min(size, 128)
+        imgs, kx, ky, v = synthetic_pose(32, size=size)
+        batches = synthetic_pose_batches(imgs, kx, ky, v, args.batch_size)
+
+    state = None
+    preds, trues, viss = [], [], []
+    for batch in batches:
+        if state is None:
+            state = _load(args.model, args.workdir, batch["image"][:1],
+                          num_heatmaps=batch["kx"].shape[1])
+        heat = np.asarray(_apply(state, batch["image"])[-1])  # last stack
+        grid = heat.shape[1]
+        preds.append(heatmap_argmax_keypoints(heat) / grid)
+        trues.append(np.stack([batch["kx"], batch["ky"]], axis=-1))
+        viss.append(batch["v"])
+    pred = np.concatenate(preds)
+    true = np.concatenate(trues)
+    vis = np.concatenate(viss)
+    # normalized coords; PCK reference length = the standard head
+    # fraction of the (crop-normalized) body: ``--norm`` of the frame
+    out = pck(pred, true, vis,
+              norm_length=np.full(len(pred), args.norm),
+              threshold=args.threshold)
+    print(json.dumps({
+        "metric": f"PCK@{args.threshold}", "norm": args.norm,
+        "value": round(out["pck"], 4),
+        "per_joint": [round(float(x), 4) if np.isfinite(x) else None
+                      for x in out["per_joint"]],
+    }))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("detection")
+    sp.add_argument("-m", "--model", default="yolov3")
+    sp.add_argument("--workdir", default=None)
+    sp.add_argument("--data-dir", default=None)
+    sp.add_argument("--split", default="val")
+    sp.add_argument("--names", default="voc", choices=["voc", "mscoco"])
+    sp.add_argument("--size", type=int, default=416)
+    sp.add_argument("--batch-size", type=int, default=16)
+    sp.add_argument("--score", type=float, default=0.05)
+    sp.add_argument("--iou", type=float, default=0.5)
+    sp.add_argument("--ap-method", default="area",
+                    choices=["area", "11point"])
+    sp.set_defaults(fn=cmd_detection)
+
+    sp = sub.add_parser("pose")
+    sp.add_argument("-m", "--model", default="hourglass104")
+    sp.add_argument("--workdir", default=None)
+    sp.add_argument("--data-dir", default=None)
+    sp.add_argument("--split", default="val")
+    sp.add_argument("--size", type=int, default=256)
+    sp.add_argument("--batch-size", type=int, default=16)
+    sp.add_argument("--threshold", type=float, default=0.5)
+    sp.add_argument("--norm", type=float, default=0.1,
+                    help="PCK reference length as a fraction of the "
+                         "normalized crop (0.1 ≈ head fraction)")
+    sp.set_defaults(fn=cmd_pose)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Evaluation metrics (mAP, PCK) — hand-computed fixtures.
+
+These complete capabilities the reference never shipped: mAP is
+explicitly WIP there (ref: YOLO/tensorflow/README.md:28) and PCKh is
+never reported (SURVEY §6).
+"""
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.eval import average_precision, evaluate_map, pck
+from deepvision_tpu.eval.pose import heatmap_argmax_keypoints
+
+# ----------------------------------------------------------------- AP
+
+
+def test_average_precision_fixture():
+    # 4 detections, 2 GT: TP, FP, TP, FP → recall .5,.5,1,1
+    recall = np.array([0.5, 0.5, 1.0, 1.0])
+    precision = np.array([1.0, 0.5, 2 / 3, 0.5])
+    # area: envelope → p=1 up to r=.5, p=2/3 up to r=1
+    want = 0.5 * 1.0 + 0.5 * (2 / 3)
+    assert average_precision(recall, precision) == pytest.approx(want)
+    # 11-point: thresholds 0..0.5 see max-p 1.0 (6 pts), 0.6..1.0 see 2/3
+    want11 = (6 * 1.0 + 5 * (2 / 3)) / 11
+    assert average_precision(
+        recall, precision, method="11point"
+    ) == pytest.approx(want11)
+
+
+def test_evaluate_map_greedy_matching():
+    gts = [{
+        "boxes": np.array([[0, 0, 10, 10], [20, 20, 30, 30]], float),
+        "classes": np.array([0, 0]),
+    }]
+    dets = [{
+        # det0 hits gt0 (high score), det1 duplicates gt0 (FP),
+        # det2 hits gt1, det3 is in empty space (FP)
+        "boxes": np.array([
+            [0, 0, 10, 10], [1, 1, 10, 10], [20, 20, 30, 30],
+            [50, 50, 60, 60],
+        ], float),
+        "scores": np.array([0.9, 0.8, 0.7, 0.6]),
+        "classes": np.array([0, 0, 0, 0]),
+    }]
+    out = evaluate_map(dets, gts, num_classes=2)
+    # PR: TP,FP,TP,FP → recalls .5,.5,1,1 precisions 1,.5,2/3,.5
+    want = 0.5 * 1.0 + 0.5 * (2 / 3)
+    assert out["ap"][0] == pytest.approx(want)
+    assert np.isnan(out["ap"][1])  # no GT for class 1 → excluded
+    assert out["map"] == pytest.approx(want)
+    assert out["num_gt"].tolist() == [2, 0]
+
+
+def test_evaluate_map_perfect_and_empty():
+    gt = [{"boxes": np.array([[0, 0, 4, 4]], float),
+           "classes": np.array([1])}]
+    det_perfect = [{"boxes": np.array([[0, 0, 4, 4]], float),
+                    "scores": np.array([0.9]), "classes": np.array([1])}]
+    out = evaluate_map(det_perfect, gt, num_classes=3)
+    assert out["ap"][1] == pytest.approx(1.0)
+    det_none = [{"boxes": np.zeros((0, 4)), "scores": np.zeros(0),
+                 "classes": np.zeros(0, int)}]
+    out = evaluate_map(det_none, gt, num_classes=3)
+    assert out["ap"][1] == 0.0
+
+
+def test_evaluate_map_iou_threshold():
+    gt = [{"boxes": np.array([[0, 0, 10, 10]], float),
+           "classes": np.array([0])}]
+    det = [{"boxes": np.array([[5, 0, 15, 10]], float),  # IoU = 1/3
+            "scores": np.array([0.9]), "classes": np.array([0])}]
+    assert evaluate_map(det, gt, 1, iou_thresh=0.5)["map"] == 0.0
+    assert evaluate_map(det, gt, 1, iou_thresh=0.3)["map"] == 1.0
+
+
+# ---------------------------------------------------------------- PCK
+
+
+def test_pck_fixture():
+    true = np.zeros((2, 3, 2))
+    pred = np.zeros((2, 3, 2))
+    pred[0, 0] = [0.4, 0.0]   # dist .4 < .5 → correct
+    pred[0, 1] = [0.0, 0.9]   # dist .9 > .5 → wrong
+    pred[1, 2] = [10.0, 0.0]  # invisible → ignored
+    vis = np.array([[1, 1, 1], [1, 1, 0]])
+    out = pck(pred, true, vis, norm_length=np.ones(2))
+    # visible: 5 joints, correct: (0,0),(0,2),(1,0),(1,1) = 4
+    assert out["pck"] == pytest.approx(4 / 5)
+    assert out["per_joint"][0] == pytest.approx(1.0)
+    assert out["per_joint"][1] == pytest.approx(0.5)
+    assert out["count"].tolist() == [2, 2, 1]
+
+
+def test_heatmap_argmax_roundtrip():
+    from deepvision_tpu.ops.heatmap import gaussian_heatmaps
+
+    kx = np.array([[0.25, 0.75]])
+    ky = np.array([[0.5, 0.25]])
+    v = np.ones((1, 2), np.int32)
+    hm = np.asarray(gaussian_heatmaps(kx, ky, v, height=16, width=16))
+    xy = heatmap_argmax_keypoints(hm)
+    np.testing.assert_allclose(xy[0, 0], [4, 8])
+    np.testing.assert_allclose(xy[0, 1], [12, 4])
+
+
+# ------------------------------------------------------------ CLI
+
+
+def test_evaluate_detection_cli_runs(capsys):
+    import json
+
+    import evaluate
+
+    evaluate.main([
+        "detection", "--size", "128", "--batch-size", "8",
+        "--score", "0.0",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "mAP"
+    assert 0.0 <= out["value"] <= 1.0
+    assert out["images"] == 64
+
+
+def test_evaluate_pose_cli_runs(capsys):
+    import json
+
+    import evaluate
+
+    evaluate.main([
+        "pose", "--size", "64", "--batch-size", "8",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "PCK@0.5"
+    assert 0.0 <= out["value"] <= 1.0
+    assert len(out["per_joint"]) == 16
