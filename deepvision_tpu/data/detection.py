@@ -155,16 +155,18 @@ def make_detection_dataset(
     shuffle_buffer: int = 1000,
     num_process: int = 1,
     process_index: int = 0,
+    seed: int = 0,
 ):
     tf = _tf()
     files = tf.data.Dataset.list_files(
-        file_pattern, shuffle=is_training, seed=0
+        file_pattern, shuffle=is_training, seed=seed
     )
     if num_process > 1:
         files = files.shard(num_process, process_index)
     ds = tf.data.TFRecordDataset(files, num_parallel_reads=tf.data.AUTOTUNE)
     if is_training:
-        ds = ds.shuffle(shuffle_buffer).repeat()
+        # epoch-seeded: deterministic order restore across resumes
+        ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
 
     def prep(serialized):
         image, boxes, labels = parse_detection_example(serialized)
@@ -248,7 +250,8 @@ def make_detection_data(
 
     def train_data(epoch: int):
         ds = make_detection_dataset(
-            str(d / train_pattern), batch_size, size, is_training=True
+            str(d / train_pattern), batch_size, size, is_training=True,
+            seed=epoch,
         )
         return _iter(ds, limit=steps_per_epoch)
 

@@ -105,20 +105,24 @@ def make_dataset(
     num_process: int = 1,
     process_index: int = 0,
     as_uint8: bool = False,
+    seed: int = 0,
 ):
     """tf.data pipeline over sharded TFRecords; per-host file sharding for
     multi-host (the ``experimental_distribute_dataset`` analog —
     ref: YOLO/tensorflow/train.py:291-294)."""
     tf = _tf()
     files = tf.data.Dataset.list_files(file_pattern, shuffle=is_training,
-                                       seed=0)
+                                       seed=seed)
     if num_process > 1:
         files = files.shard(num_process, process_index)
     ds = tf.data.TFRecordDataset(
         files, num_parallel_reads=tf.data.AUTOTUNE
     )
     if is_training:
-        ds = ds.shuffle(shuffle_buffer).repeat()
+        # epoch-seeded shuffle: resume at epoch N reproduces the order an
+        # uninterrupted run would have seen (SURVEY §5.3 — the
+        # deterministic data-order restore the reference lacks)
+        ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
     ds = ds.map(
         lambda s: parse_and_preprocess(s, size, is_training, as_uint8),
         num_parallel_calls=tf.data.AUTOTUNE,
@@ -175,7 +179,8 @@ def make_imagenet_data(
         # the locals into the global array (local × nproc = global).
         ds = make_dataset(str(d / "train-*"), local_bs, size,
                           is_training=True, as_uint8=train_as_uint8,
-                          num_process=nproc, process_index=pid)
+                          num_process=nproc, process_index=pid,
+                          seed=epoch)
         return _as_batches(ds, steps)
 
     def val_data():

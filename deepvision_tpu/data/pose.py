@@ -123,16 +123,18 @@ def make_pose_dataset(
     shuffle_buffer: int = 1000,
     num_process: int = 1,
     process_index: int = 0,
+    seed: int = 0,
 ):
     tf = _tf()
     files = tf.data.Dataset.list_files(
-        file_pattern, shuffle=is_training, seed=0
+        file_pattern, shuffle=is_training, seed=seed
     )
     if num_process > 1:
         files = files.shard(num_process, process_index)
     ds = tf.data.TFRecordDataset(files, num_parallel_reads=tf.data.AUTOTUNE)
     if is_training:
-        ds = ds.shuffle(shuffle_buffer).repeat()
+        # epoch-seeded: deterministic order restore across resumes
+        ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
 
     def prep(serialized):
         image, kx, ky, v, scale = parse_pose_example(serialized)
@@ -192,7 +194,8 @@ def make_pose_data(
 
     def train_data(epoch: int):
         ds = make_pose_dataset(
-            str(d / train_pattern), batch_size, size, is_training=True
+            str(d / train_pattern), batch_size, size, is_training=True,
+            seed=epoch,
         )
         return iter_tf_batches(ds, keys, limit=steps_per_epoch)
 

@@ -99,10 +99,9 @@ def main():
             size = min(size, 128)  # keep the synthetic smoke config small
             imgs, kx, ky, v = synthetic_pose(n, size=size)
             split = max(cfg["batch_size"], int(n * 0.1))
-            rng = np.random.default_rng(0)
             train_data = lambda e: synthetic_pose_batches(
                 imgs[split:], kx[split:], ky[split:], v[split:],
-                cfg["batch_size"], rng=rng,
+                cfg["batch_size"], rng=np.random.default_rng(e),
             )
             val_data = lambda: synthetic_pose_batches(
                 imgs[:split], kx[:split], ky[:split], v[:split],
@@ -143,10 +142,9 @@ def main():
                 n, size=size, num_classes=cfg["num_classes"]
             )
             split = max(cfg["batch_size"], int(n * 0.1))
-            rng = np.random.default_rng(0)
             train_data = lambda e: synthetic_batches(
                 imgs[split:], boxes[split:], labels[split:],
-                cfg["batch_size"], rng=rng,
+                cfg["batch_size"], rng=np.random.default_rng(e),
             )
             val_data = lambda: synthetic_batches(
                 imgs[:split], boxes[:split], labels[:split],
@@ -171,8 +169,8 @@ def main():
             os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
             os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
         )
-        rng = np.random.default_rng(0)
-        train_data = lambda e: batches(tr_i, tr_l, cfg["batch_size"], rng=rng)
+        train_data = lambda e: batches(tr_i, tr_l, cfg["batch_size"],
+                                       rng=np.random.default_rng(e))
         val_data = lambda: batches(te_i, te_l, cfg["batch_size"],
                                    drop_remainder=False)
         steps = len(tr_l) // cfg["batch_size"]
@@ -188,9 +186,9 @@ def main():
             for i in range(n):  # make it learnable
                 imgs[i, :, :, 0] += (labels[i] % 7) * 0.3
         split = max(cfg["batch_size"], int(n * 0.1))
-        rng = np.random.default_rng(0)
         train_data = lambda e: batches(imgs[split:], labels[split:],
-                                       cfg["batch_size"], rng=rng)
+                                       cfg["batch_size"],
+                                       rng=np.random.default_rng(e))
         val_data = lambda: batches(imgs[:split], labels[:split],
                                    cfg["batch_size"], drop_remainder=False)
         steps = (n - split) // cfg["batch_size"]
@@ -263,9 +261,8 @@ def run_gan(args, cfg, dtype):
             imgs, _ = synthetic_mnist(args.synthetic_size)
             imgs = imgs[:, 2:30, 2:30, :]  # 28² (DCGAN geometry)
         imgs = (imgs * 2.0 - 1.0).astype(np.float32)  # [-1, 1] (ref :26)
-        rng = np.random.default_rng(0)
         train_data = lambda e: iter_array_batches(
-            {"image": imgs}, bs, rng=rng
+            {"image": imgs}, bs, rng=np.random.default_rng(e)
         )
         state = create_dcgan_state(
             get_model("dcgan_generator", dtype=dtype),
@@ -289,10 +286,9 @@ def run_gan(args, cfg, dtype):
 
             size = min(size, 64)
             a, b = synthetic_unpaired(args.synthetic_size, size=size)
-            rng = np.random.default_rng(0)
             steps = len(a) // bs
             train_data = lambda e: iter_array_batches(
-                {"a": a, "b": b}, bs, rng=rng
+                {"a": a, "b": b}, bs, rng=np.random.default_rng(e)
             )
         lr = linear_decay(
             cfg["optimizer_params"]["lr"],
