@@ -422,6 +422,7 @@ def fit_gan(
     resume: bool = False,
     resume_epoch: int | None = None,
     check_numerics: bool = False,
+    shard_weight_update: bool = False,
 ):
     """Minimal GAN epoch loop: compiled step + loggers + TB + Orbax saves
     every ``save_every`` epochs keeping 3 (ref: DCGAN/tensorflow/main.py:39,
@@ -444,10 +445,15 @@ def fit_gan(
         start_epoch = meta["epoch"] + 1
         if meta.get("loggers"):
             loggers = meta["loggers"]
+    state_spec = None
+    if shard_weight_update:
+        from deepvision_tpu.core.step import weight_update_sharding
+
+        state_spec = weight_update_sharding(state, mesh)
     compiler = (
         compile_checked_train_step if check_numerics else compile_train_step
     )
-    step = compiler(train_step, mesh)
+    step = compiler(train_step, mesh, state_spec=state_spec)
     key = jax.random.key(np.uint32(1234))
     for epoch in range(start_epoch, epochs):
         t0 = time.time()

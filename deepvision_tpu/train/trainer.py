@@ -53,6 +53,7 @@ class Trainer:
         log_every: int = 10,
         seed: int = 0,
         check_numerics: bool = False,
+        shard_weight_update: bool = False,
     ):
         self.model = model
         self.config = config
@@ -70,13 +71,28 @@ class Trainer:
             (1, size, size, config.get("channels", 3)), np.float32
         )
         self.state = create_train_state(model, self.tx, sample, rng=seed)
+        state_spec = None
+        if shard_weight_update:
+            # ZeRO-1 analog: optimizer state + weight update sharded over
+            # the data axis (core/step.weight_update_sharding)
+            from deepvision_tpu.core.step import weight_update_sharding
+
+            state_spec = weight_update_sharding(self.state, mesh)
         if check_numerics:  # NaN/Inf tripwire (SURVEY §5.2)
             from deepvision_tpu.core.step import compile_checked_train_step
 
-            self._train_step = compile_checked_train_step(train_step, mesh)
+            self._train_step = compile_checked_train_step(
+                train_step, mesh, state_spec=state_spec
+            )
         else:
-            self._train_step = compile_train_step(train_step, mesh)
-        self._eval_step = compile_eval_step(eval_step, mesh)
+            self._train_step = compile_train_step(
+                train_step, mesh, state_spec=state_spec
+            )
+        # eval must see the SAME state sharding: pinning a sharded
+        # opt_state to replicated would all-gather it every val batch
+        self._eval_step = compile_eval_step(
+            eval_step, mesh, state_spec=state_spec
+        )
         self.loggers = Loggers()
         self.tb = TensorBoardWriter(self.workdir / "tb")
         self.ckpt = CheckpointManager(self.workdir / "ckpt")

@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from deepvision_tpu.core import (
@@ -63,3 +64,61 @@ def test_checked_step_catches_nan(mesh8):
     bad = {"image": np.full((8, 4), -1.0, np.float32)}
     with pytest.raises(Exception, match="nan"):
         step(jnp.zeros(()), bad, jax.random.key(0))
+
+
+def test_weight_update_sharding_matches_replicated(mesh8):
+    """ZeRO-1 analog (arXiv:2004.13336): sharding the optimizer state
+    over the data axis must not change the training numerics — and the
+    momentum buffers must actually be distributed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from deepvision_tpu.core import shard_batch
+    from deepvision_tpu.core.step import (
+        compile_train_step,
+        weight_update_sharding,
+    )
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.state import create_train_state
+    from deepvision_tpu.train.steps import classification_train_step
+
+    r = np.random.default_rng(0)
+    batch = {
+        "image": r.normal(size=(16, 32, 32, 1)).astype(np.float32),
+        "label": r.integers(0, 10, 16).astype(np.int32),
+    }
+    model = get_model("lenet5", num_classes=10)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    def train(state_spec):
+        state = create_train_state(model, tx, batch["image"][:1])
+        step = compile_train_step(
+            classification_train_step, mesh8, state_spec=state_spec
+        )
+        db = shard_batch(mesh8, batch)
+        key = jax.random.key(0)
+        for i in range(3):
+            state, metrics = step(state, db, jax.random.fold_in(key, i))
+        return state, float(metrics["loss"])
+
+    base_state, base_loss = train(None)
+    spec = weight_update_sharding(
+        create_train_state(model, tx, batch["image"][:1]), mesh8
+    )
+    # at least one momentum leaf actually sharded over 'data'
+    assert any(
+        s != P() for s in jax.tree.leaves(
+            spec.opt_state, is_leaf=lambda x: isinstance(x, P)
+        )
+    )
+    z1_state, z1_loss = train(spec)
+    assert z1_loss == pytest.approx(base_loss, rel=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(base_state.params), jax.tree.leaves(z1_state.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        )

@@ -45,6 +45,10 @@ def parse_args():
     p.add_argument("--check-numerics", action="store_true",
                    help="run the train step under checkify float checks "
                         "(NaN/Inf raise with the failing op; ~2x slower)")
+    p.add_argument("--shard-weight-update", action="store_true",
+                   help="ZeRO-1-style optimizer-state sharding over the "
+                        "data axis (arXiv:2004.13336); saves optimizer "
+                        "memory per chip, identical numerics")
     return p.parse_args()
 
 
@@ -198,7 +202,8 @@ def main():
     trainer = Trainer(
         model, cfg, mesh, train_data, val_data,
         workdir=args.workdir, steps_per_epoch=steps,
-        check_numerics=args.check_numerics, **step_fns,
+        check_numerics=args.check_numerics,
+        shard_weight_update=args.shard_weight_update, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
@@ -312,6 +317,7 @@ def run_gan(args, cfg, dtype):
         resume=args.resume or args.checkpoint is not None,
         resume_epoch=args.checkpoint,
         check_numerics=args.check_numerics,
+        shard_weight_update=args.shard_weight_update,
     )
     _maybe_publish(args, f"{workdir}/ckpt")
 
