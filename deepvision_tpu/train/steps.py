@@ -269,3 +269,24 @@ def centernet_eval_step(state: TrainState, batch: dict) -> dict:
         "loss_sum": jnp.sum(parts["loss"] * mask),
         "count": jnp.sum(mask),
     }
+
+
+def aggregate_eval_parts(parts) -> tuple[dict, float]:
+    """Sum an iterable of eval-step outputs (count-weighted sums + a
+    'count' key) into ``(val_* means, total count)`` — the one masked
+    exact-aggregation impl shared by Trainer.validate and evaluate.py.
+    '<k>_sum' and bare keys both become ``val_<k>`` means."""
+    totals = None
+    for part in parts:
+        part = {k: float(v) for k, v in part.items()}
+        if totals is None:
+            totals = part
+        else:
+            totals = {k: totals[k] + part[k] for k in totals}
+    if not totals:
+        return {}, 0.0
+    n = totals.pop("count")
+    return {
+        f"val_{k[:-4] if k.endswith('_sum') else k}": v / n
+        for k, v in totals.items()
+    }, n

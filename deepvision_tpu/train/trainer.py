@@ -32,6 +32,7 @@ from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
 from deepvision_tpu.train.optimizers import make_optimizer, set_lr_scale
 from deepvision_tpu.train.state import create_train_state
 from deepvision_tpu.train.steps import (
+    aggregate_eval_parts,
     classification_eval_step,
     classification_train_step,
 )
@@ -179,24 +180,11 @@ class Trainer:
         return out
 
     def validate(self) -> dict:
-        totals = None
-        for batch in self.val_data():
-            part = self._eval_step(self.state, shard_batch(self.mesh, batch))
-            part = {k: float(v) for k, v in part.items()}
-            if totals is None:
-                totals = part
-            else:
-                totals = {k: totals[k] + part[k] for k in totals}
-        if not totals:
-            return {}
-        n = totals.pop("count")
-        # generic: every step output is a count-weighted sum; "<k>_sum" and
-        # bare keys both become val_<k> means (works for classification's
-        # loss/top1/top5 and detection's loss alike)
-        return {
-            f"val_{k[:-4] if k.endswith('_sum') else k}": v / n
-            for k, v in totals.items()
-        }
+        metrics, _ = aggregate_eval_parts(
+            self._eval_step(self.state, shard_batch(self.mesh, batch))
+            for batch in self.val_data()
+        )
+        return metrics
 
     def fit(self, epochs: int | None = None) -> Loggers:
         total = epochs or self.config.get("total_epochs", 1)

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""Offline evaluation CLI: detection mAP and pose PCK.
+"""Offline evaluation CLI: classification top-1/5, detection mAP, pose PCK.
 
 Completes the evaluation surface the reference never shipped (mAP is
 explicitly WIP there, ref: YOLO/tensorflow/README.md:28; PCKh is never
-reported). Classification top-1/5 already comes from ``train.py``'s
-exact masked validation pass.
+reported); the classification subcommand is the exact masked full-set
+validation pass runnable against any checkpoint.
 
+    evaluate.py classification -m resnet50 --workdir runs/resnet50 --data-dir /data/imagenet
     evaluate.py detection -m yolov3 --workdir runs/yolov3 --data-dir /data/voc
     evaluate.py pose -m hourglass104 --workdir runs/hourglass104 --data-dir /data/mpii
 
@@ -31,6 +32,68 @@ def _apply(state, images):
     from predict import _apply as apply_fn  # one shared eval-apply impl
 
     return apply_fn(state, images)
+
+
+def cmd_classification(args):
+    """Exact masked top-1/top-5 over the full validation set (the
+    reference's validate pass, ref: ResNet/pytorch/train.py:488-520,
+    without its batch-tail drop)."""
+    import jax
+
+    from deepvision_tpu.core import create_mesh, shard_batch
+    from deepvision_tpu.core.step import compile_eval_step
+    from deepvision_tpu.train.configs import get_config
+    from deepvision_tpu.train.steps import classification_eval_step
+
+    cfg = get_config(args.model)
+    size, ch = cfg["input_size"], cfg["channels"]
+    bs = args.batch_size
+
+    if args.data_dir and cfg["dataset"] == "imagenet":
+        from deepvision_tpu.data.imagenet import make_imagenet_data
+
+        _, val_data, _ = make_imagenet_data(args.data_dir, bs, size)
+        batches = val_data()
+    elif args.data_dir and cfg["dataset"] == "mnist":
+        import os
+
+        from deepvision_tpu.data.mnist import batches as mk, load_mnist_idx
+
+        te_i, te_l = load_mnist_idx(
+            os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+        )
+        batches = mk(te_i, te_l, bs, drop_remainder=False)
+    else:
+        from deepvision_tpu.data.mnist import batches as mk, synthetic_mnist
+
+        if cfg["dataset"] == "mnist":
+            imgs, labels = synthetic_mnist(256)
+        else:
+            r = np.random.default_rng(0)
+            labels = r.integers(0, cfg["num_classes"], 256).astype(np.int32)
+            imgs = r.normal(0, 1, (256, size, size, ch)).astype(np.float32)
+        batches = mk(imgs, labels, bs, drop_remainder=False)
+
+    from deepvision_tpu.train.steps import aggregate_eval_parts
+
+    mesh = create_mesh()
+    state = None
+    step = compile_eval_step(classification_eval_step, mesh)
+
+    def parts():
+        nonlocal state
+        for batch in batches:
+            if state is None:
+                state = _load(args.model, args.workdir, batch["image"][:1],
+                              num_classes=cfg["num_classes"])
+            yield step(state, shard_batch(mesh, batch))
+
+    metrics, n = aggregate_eval_parts(parts())
+    print(json.dumps({
+        "metric": "classification_eval", "images": int(n),
+        **{k: round(v, 4) for k, v in metrics.items()},
+    }))
 
 
 def cmd_detection(args):
@@ -157,6 +220,13 @@ def cmd_pose(args):
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("classification")
+    sp.add_argument("-m", "--model", default="resnet50")
+    sp.add_argument("--workdir", default=None)
+    sp.add_argument("--data-dir", default=None)
+    sp.add_argument("--batch-size", type=int, default=64)
+    sp.set_defaults(fn=cmd_classification)
 
     sp = sub.add_parser("detection")
     sp.add_argument("-m", "--model", default="yolov3")

@@ -232,3 +232,24 @@ def test_keras_h5_roundtrip(tmp_path, fixture_image):
     )
     keras_logits = keras_model(fixture_image, training=False).numpy()
     np.testing.assert_allclose(flax_logits, keras_logits, atol=1e-4)
+
+
+def test_pretrained_hash_verification(tmp_path, torch_model):
+    """Hash-verified ingestion (the ref's by-hash download check,
+    resnet50v2.py:137-153, file-first)."""
+    from deepvision_tpu.convert.pretrained import (
+        file_digest,
+        load_pretrained,
+        verify_artifact,
+    )
+
+    path = tmp_path / "resnet50.pt"
+    torch.save(torch_model.state_dict(), path)
+    digest = file_digest(path)
+    assert verify_artifact(path, digest) == path
+    with pytest.raises(ValueError, match="mismatch"):
+        verify_artifact(path, "0" * 64)
+    variables = load_pretrained(path, expected_digest=digest)
+    assert "params" in variables and "batch_stats" in variables
+    with pytest.raises(ValueError, match="unrecognized"):
+        load_pretrained(tmp_path / "weights.xyz")

@@ -134,3 +134,17 @@ def test_evaluate_pose_cli_runs(capsys):
     assert out["metric"] == "PCK@0.5"
     assert 0.0 <= out["value"] <= 1.0
     assert len(out["per_joint"]) == 16
+
+
+def test_evaluate_classification_cli_runs(capsys):
+    import json
+
+    import evaluate
+
+    evaluate.main([
+        "classification", "-m", "lenet5", "--batch-size", "32",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "classification_eval"
+    assert out["images"] == 256
+    assert 0.0 <= out["val_top1"] <= 1.0
