@@ -38,8 +38,6 @@ def cmd_classification(args):
     """Exact masked top-1/top-5 over the full validation set (the
     reference's validate pass, ref: ResNet/pytorch/train.py:488-520,
     without its batch-tail drop)."""
-    import jax
-
     from deepvision_tpu.core import create_mesh, shard_batch
     from deepvision_tpu.core.step import compile_eval_step
     from deepvision_tpu.train.configs import get_config

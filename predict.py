@@ -259,6 +259,54 @@ def cmd_cyclegan(args):
     save_image(args.output, out)
 
 
+def cmd_curves(args):
+    """Re-plot the metric curves stored INSIDE the checkpoint — the
+    reference's notebook workflow (loggers dict persisted with the model,
+    ref: ResNet/pytorch/train.py:417-428, re-plotted in
+    notebooks/ResNet50.ipynb)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(f"{args.workdir}/ckpt")
+    epoch = mgr.latest_epoch()
+    if epoch is None:
+        sys.exit(f"no checkpoints under {args.workdir}/ckpt")
+    # read only the JSON meta (loggers live there, not in the state)
+    import json as _json
+
+    meta_path = (
+        Path(mgr.directory) / str(epoch) / "meta" / "metadata"
+    )
+    meta = _json.loads(meta_path.read_text())
+    mgr.close()
+    from deepvision_tpu.train.loggers import Loggers
+
+    loggers = Loggers.from_json(meta["loggers"])
+    metrics = sorted(loggers.data)
+    if not metrics:
+        sys.exit("checkpoint has no logged metrics")
+    cols = 2
+    rows = (len(metrics) + cols - 1) // cols
+    fig, axes = plt.subplots(rows, cols, figsize=(10, 3 * rows),
+                             squeeze=False)
+    for ax, name in zip(axes.flat, metrics):
+        series = loggers.data[name]
+        ax.plot(series["epochs"], series["value"])
+        ax.set_title(name)
+        ax.set_xlabel("epoch")
+        ax.grid(alpha=0.3)
+    for ax in axes.flat[len(metrics):]:
+        ax.axis("off")
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    print(f"wrote {args.output} ({len(metrics)} curves, "
+          f"epoch {epoch})")
+
+
 def cmd_export(args):
     from deepvision_tpu.export import export_forward, save_exported
 
@@ -318,6 +366,11 @@ def main(argv=None):
     sp.add_argument("--direction", default="a2b", choices=["a2b", "b2a"])
     sp.add_argument("--size", type=int, default=256)
     sp.set_defaults(fn=cmd_cyclegan)
+
+    sp = sub.add_parser("curves")
+    sp.add_argument("--workdir", required=True)
+    sp.add_argument("-o", "--output", default="curves.png")
+    sp.set_defaults(fn=cmd_curves)
 
     sp = sub.add_parser("export")
     common(sp, model="resnet50", images=False)

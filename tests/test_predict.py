@@ -182,3 +182,32 @@ def test_publish_gracefully_skips_without_gcs(tmp_path, capsys, monkeypatch):
     monkeypatch.setattr(builtins, "__import__", no_gcs)
     assert publish.publish_to_gcs(tmp_path, "bucket", "dir") is None
     assert "skipping upload" in capsys.readouterr().out
+
+
+def test_predict_curves_from_checkpoint(tmp_path, capsys):
+    """The reference's notebook workflow: metric curves live inside the
+    checkpoint and are re-plotted from it (ref: ResNet/pytorch/
+    train.py:417-428 + notebooks)."""
+    import optax
+
+    import predict
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.loggers import Loggers
+    from deepvision_tpu.train.state import create_train_state
+
+    model = get_model("lenet5", num_classes=10)
+    state = create_train_state(
+        model, optax.sgd(0.1), np.zeros((1, 32, 32, 1), np.float32)
+    )
+    loggers = Loggers()
+    for e in range(3):
+        loggers.log_metrics(e, {"train_loss": 2.0 - e * 0.5,
+                                "val_top1": 0.3 + e * 0.2})
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(2, state, loggers=loggers)
+    mgr.close()
+    out = tmp_path / "curves.png"
+    predict.main(["curves", "--workdir", str(tmp_path), "-o", str(out)])
+    assert out.exists() and out.stat().st_size > 0
+    assert "2 curves" in capsys.readouterr().out
