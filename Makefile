@@ -44,6 +44,20 @@ dryrun:
 tensorboard:
 	tensorboard --logdir $(WORKDIR) --port 6006
 
+# offline metrics (mAP / PCK / exact top-1) against the latest checkpoint
+eval_detection:
+	$(PY) evaluate.py detection -m yolov3 --workdir $(WORKDIR)/yolov3 $(DATA_FLAG)
+
+eval_pose:
+	$(PY) evaluate.py pose -m hourglass104 --workdir $(WORKDIR)/hourglass104 $(DATA_FLAG)
+
+eval_classification:
+	$(PY) evaluate.py classification -m resnet50 --workdir $(WORKDIR)/resnet50 $(DATA_FLAG)
+
+# loss/accuracy curves re-plotted from inside the checkpoint
+curves_%:
+	$(PY) predict.py curves --workdir $(WORKDIR)/$* -o $*-curves.png
+
 find-python:
 	ps -ef | grep python
 

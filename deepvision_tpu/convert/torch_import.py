@@ -141,3 +141,97 @@ def resnet_torch_to_flax(state_dict: Mapping) -> dict:
     """Reference ResNet-34/50/152 torch weights → Flax variables for
     ``models.resnet`` (same mapping covers all three depths)."""
     return torch_to_flax(state_dict, _resnet_key)
+
+
+def sequential_torch_to_flax(
+    state_dict: Mapping,
+    layer_names: list[str],
+    *,
+    flatten_grid: tuple[int, int] | None = None,
+) -> dict:
+    """Ordered conv/linear torch nets (VGG/AlexNet/LeNet families, whose
+    state dicts are ``features.N``/``classifier.N`` Sequential keys —
+    ref: VGG/pytorch/models/vgg16.py, AlexNet/pytorch/models/alexnet_v2.py)
+    → Flax variables, zipping the torch modules in order with
+    ``layer_names``.
+
+    ``flatten_grid``: the (H, W) of the activation entering the first
+    linear layer. torch flattens NCHW (C·H·W order) while the Flax models
+    flatten NHWC, so that weight's input dimension is permuted
+    C,H,W → H,W,C before transposing.
+    """
+    sd = {
+        k: _to_numpy(v)
+        for k, v in strip_module_prefix(dict(state_dict)).items()
+    }
+    prefixes: list[str] = []
+    for k in sd:
+        p = k.rsplit(".", 1)[0]
+        if p not in prefixes:
+            prefixes.append(p)
+    if len(prefixes) != len(layer_names):
+        raise ValueError(
+            f"{len(prefixes)} torch layers vs {len(layer_names)} names"
+        )
+    params: dict = {}
+    prev_channels = None
+    first_linear = True
+    for prefix, name in zip(prefixes, layer_names):
+        if f"{prefix}.bias" not in sd:
+            raise KeyError(
+                f"{prefix}: no bias — sequential mapping covers "
+                "conv/linear layers with bias only"
+            )
+        w = sd[f"{prefix}.weight"].astype(np.float32)
+        b = sd[f"{prefix}.bias"].astype(np.float32)
+        if w.ndim == 4:  # conv (O, I, KH, KW) -> (KH, KW, I, O)
+            kernel = w.transpose(2, 3, 1, 0)
+            prev_channels = w.shape[0]
+        elif w.ndim == 2:  # linear (O, I) -> (I, O)
+            if first_linear and prev_channels is not None:
+                # the conv→linear boundary: torch flattened NCHW, the
+                # Flax models flatten NHWC — permute or fail LOUDLY
+                # (a silent skip would scramble the fc weights)
+                if flatten_grid is None:
+                    if w.shape[1] != prev_channels:
+                        raise ValueError(
+                            f"{prefix}: in_features {w.shape[1]} != "
+                            f"{prev_channels} conv channels — this net "
+                            "flattens a spatial grid; pass flatten_grid"
+                        )
+                else:
+                    h, wd = flatten_grid
+                    if w.shape[1] != prev_channels * h * wd:
+                        raise ValueError(
+                            f"{prefix}: in_features {w.shape[1]} != "
+                            f"{prev_channels}·{h}·{wd} — wrong "
+                            "flatten_grid for this architecture"
+                        )
+                    w = (
+                        w.reshape(w.shape[0], prev_channels, h, wd)
+                        .transpose(0, 2, 3, 1)
+                        .reshape(w.shape[0], -1)
+                    )
+            first_linear = False
+            kernel = w.T
+        else:
+            raise ValueError(
+                f"{prefix}: unsupported weight rank {w.ndim} "
+                "(BatchNorm-style layers need a per-architecture key_fn, "
+                "see torch_to_flax)"
+            )
+        params[name] = {"kernel": kernel, "bias": b}
+    return {"params": params, "batch_stats": {}}
+
+
+# Layer orders for the reference's Sequential architectures.
+VGG16_LAYERS = [
+    "conv1_1", "conv1_2", "conv2_1", "conv2_2",
+    "conv3_1", "conv3_2", "conv3_3",
+    "conv4_1", "conv4_2", "conv4_3",
+    "conv5_1", "conv5_2", "conv5_3",
+    "fc1", "fc2", "fc3",
+]
+ALEXNET2_LAYERS = [
+    "conv1", "conv2", "conv3", "conv4", "conv5", "fc6", "fc7", "fc8",
+]

@@ -253,3 +253,91 @@ def test_pretrained_hash_verification(tmp_path, torch_model):
     assert "params" in variables and "batch_stats" in variables
     with pytest.raises(ValueError, match="unrecognized"):
         load_pretrained(tmp_path / "weights.xyz")
+
+
+class _TorchVGG16(tnn.Module):
+    """Independent re-statement of the reference's VGG-16 topology
+    (ref: VGG/pytorch/models/vgg16.py — config D, Sequential
+    features/classifier), for converter logits-parity."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+               512, 512, 512, "M", 512, 512, 512, "M"]
+        layers, c_in = [], 3
+        for v in cfg:
+            if v == "M":
+                layers.append(tnn.MaxPool2d(2, 2))
+            else:
+                layers += [tnn.Conv2d(c_in, v, 3, padding=1), tnn.ReLU()]
+                c_in = v
+        self.features = tnn.Sequential(*layers)
+        self.classifier = tnn.Sequential(
+            tnn.Linear(512 * 7 * 7, 4096), tnn.ReLU(),
+            tnn.Linear(4096, 4096), tnn.ReLU(),
+            tnn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.flatten(1)
+        return self.classifier(x)
+
+
+def test_sequential_converter_vgg16_logits_match():
+    """torch VGG-16 → Flax via the ordered-Sequential mapping (incl. the
+    NCHW→NHWC flatten permutation on fc1) reproduces the logits."""
+    import jax
+
+    from deepvision_tpu.convert.torch_import import (
+        VGG16_LAYERS,
+        sequential_torch_to_flax,
+    )
+    from deepvision_tpu.models import get_model
+
+    torch.manual_seed(1)
+    tm = _TorchVGG16(num_classes=10).eval()
+    variables = sequential_torch_to_flax(
+        tm.state_dict(), VGG16_LAYERS, flatten_grid=(7, 7)
+    )
+    model = get_model("vgg16", num_classes=10)
+    img = np.random.default_rng(0).normal(
+        size=(1, 224, 224, 3)
+    ).astype(np.float32)
+    flax_logits = np.asarray(
+        model.apply(
+            {"params": variables["params"]}, img, train=False
+        )
+    )
+    with torch.no_grad():
+        torch_logits = tm(
+            torch.from_numpy(img.transpose(0, 3, 1, 2))
+        ).numpy()
+    np.testing.assert_allclose(flax_logits, torch_logits, atol=2e-3)
+
+
+def test_sequential_converter_layer_count_mismatch_raises():
+    from deepvision_tpu.convert.torch_import import (
+        sequential_torch_to_flax,
+    )
+
+    sd = {"features.0.weight": np.zeros((8, 3, 3, 3)),
+          "features.0.bias": np.zeros(8)}
+    with pytest.raises(ValueError, match="torch layers"):
+        sequential_torch_to_flax(sd, ["a", "b"])
+
+
+def test_sequential_converter_wrong_grid_raises():
+    from deepvision_tpu.convert.torch_import import (
+        VGG16_LAYERS,
+        sequential_torch_to_flax,
+    )
+
+    torch.manual_seed(0)
+    tm = _TorchVGG16(num_classes=4)
+    with pytest.raises(ValueError, match="flatten_grid"):
+        sequential_torch_to_flax(
+            tm.state_dict(), VGG16_LAYERS, flatten_grid=(6, 6)
+        )
+    with pytest.raises(ValueError, match="pass flatten_grid"):
+        sequential_torch_to_flax(tm.state_dict(), VGG16_LAYERS)
