@@ -341,3 +341,58 @@ def test_sequential_converter_wrong_grid_raises():
         )
     with pytest.raises(ValueError, match="pass flatten_grid"):
         sequential_torch_to_flax(tm.state_dict(), VGG16_LAYERS)
+
+
+class _TorchAlexNetV2(tnn.Module):
+    """Independent re-statement of the reference's AlexNet V2 topology
+    (ref: AlexNet/pytorch/models/alexnet_v2.py — single column,
+    64/192/384/384/256)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = tnn.Sequential(
+            tnn.Conv2d(3, 64, 11, 4, padding=2), tnn.ReLU(),
+            tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(),
+            tnn.MaxPool2d(3, 2),
+            tnn.Conv2d(192, 384, 3, padding=1), tnn.ReLU(),
+            tnn.Conv2d(384, 384, 3, padding=1), tnn.ReLU(),
+            tnn.Conv2d(384, 256, 3, padding=1), tnn.ReLU(),
+            tnn.MaxPool2d(3, 2),
+        )
+        self.classifier = tnn.Sequential(
+            tnn.Linear(256 * 6 * 6, 4096), tnn.ReLU(),
+            tnn.Linear(4096, 4096), tnn.ReLU(),
+            tnn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(self.features(x).flatten(1))
+
+
+def test_sequential_converter_alexnet2_logits_match():
+    import jax
+
+    from deepvision_tpu.convert.torch_import import (
+        ALEXNET2_LAYERS,
+        sequential_torch_to_flax,
+    )
+    from deepvision_tpu.models import get_model
+
+    torch.manual_seed(2)
+    tm = _TorchAlexNetV2(num_classes=10).eval()
+    variables = sequential_torch_to_flax(
+        tm.state_dict(), ALEXNET2_LAYERS, flatten_grid=(6, 6)
+    )
+    model = get_model("alexnet2", num_classes=10)
+    img = np.random.default_rng(1).normal(
+        size=(1, 224, 224, 3)
+    ).astype(np.float32)
+    flax_logits = np.asarray(
+        model.apply({"params": variables["params"]}, img, train=False)
+    )
+    with torch.no_grad():
+        torch_logits = tm(
+            torch.from_numpy(img.transpose(0, 3, 1, 2))
+        ).numpy()
+    np.testing.assert_allclose(flax_logits, torch_logits, atol=1e-3)
