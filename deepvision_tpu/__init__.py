@@ -13,12 +13,17 @@ as ONE shared library instead of per-model copy-paste:
                  Pallas TPU kernels for the hot spots.
 - ``losses``   : pure-function losses (CE/top-k, YOLO multiscale, heatmap
                  MSE, GAN losses).
-- ``train``    : Trainer, optimizers, LR schedules, checkpointing (Orbax),
-                 metric loggers.  (Parallelism itself lives in ``core`` —
-                 mesh/shardings — and ``data.device_put`` — multi-host
-                 batch placement.)
+- ``train``    : Trainer + GAN loop, optimizers, LR schedules,
+                 checkpointing (Orbax), metric loggers, GCS publication.
+- ``parallel`` : explicit-collective patterns (shard_map + ppermute ring
+                 halo exchange for spatial partitioning); the default
+                 GSPMD path lives in ``core`` (mesh/shardings, ZeRO-1
+                 weight-update sharding) and ``data.device_put``
+                 (multi-host batch placement).
 - ``convert``  : PyTorch/TF checkpoint import + layer-for-layer activation
-                 diffing against the reference implementations.
+                 diffing + hash-verified pretrained ingestion.
+- ``eval``     : offline metrics (detection mAP, pose PCK) the reference
+                 never shipped.
 
 Reference behavior is cited throughout as ``ref: <file:line>`` meaning a
 path under the upstream `deep-vision` repo.
