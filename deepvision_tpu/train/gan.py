@@ -454,8 +454,11 @@ def fit_gan(
         compile_checked_train_step if check_numerics else compile_train_step
     )
     step = compiler(train_step, mesh, state_spec=state_spec)
-    key = jax.random.key(np.uint32(1234))
+    base_key = jax.random.key(np.uint32(1234))
     for epoch in range(start_epoch, epochs):
+        # epoch-derived noise stream: resume reproduces the uninterrupted
+        # run's z draws / pool coin flips (same rationale as Trainer)
+        key = jax.random.fold_in(base_key, epoch)
         t0 = time.time()
         fetched = []
         for i, batch in enumerate(train_data(epoch)):

@@ -99,7 +99,8 @@ class Trainer:
         self.ckpt = CheckpointManager(self.workdir / "ckpt")
         self.start_epoch = 0
         self.best_metric = -float("inf")
-        self._key = jax.random.key(seed + 1)
+        self._base_key = jax.random.key(seed + 1)
+        self._key = self._base_key
 
     # -- resume ----------------------------------------------------------
     def resume(self, epoch: int | None = None) -> None:
@@ -122,6 +123,10 @@ class Trainer:
 
     # -- loops -----------------------------------------------------------
     def train_epoch(self, epoch: int) -> dict:
+        # epoch-derived PRNG stream: together with the epoch-seeded data
+        # order this makes resume-at-epoch-N bit-identical to an
+        # uninterrupted run reaching epoch N (dropout masks, GAN noise)
+        self._key = jax.random.fold_in(self._base_key, epoch)
         t0 = time.perf_counter()
         counts: list[int] = []
         pending: list[dict] = []  # device scalars not yet fetched

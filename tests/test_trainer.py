@@ -94,3 +94,54 @@ def test_plateau_changes_effective_lr(tmp_path, mesh8):
     trainer.plateau.best = 2.0  # force "no improvement" every epoch
     trainer.fit(2)
     assert float(trainer.state.opt_state.hyperparams["lr_scale"]) < 1.0
+
+
+def test_resume_reproduces_uninterrupted_run(tmp_path, mesh8):
+    """Deterministic recovery (SURVEY §5.3): train 2 epochs straight vs
+    train 1 + resume + 1 — the epoch-1 metrics must be IDENTICAL
+    (epoch-seeded data order + epoch-derived PRNG stream)."""
+    import numpy as np
+
+    from deepvision_tpu.data.mnist import batches, synthetic_mnist
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.trainer import Trainer
+
+    imgs, labels = synthetic_mnist(64)
+    cfg = {
+        "name": "lenet5", "batch_size": 16, "input_size": 32,
+        "channels": 1, "num_classes": 10, "dataset": "mnist",
+        "optimizer": "adam", "optimizer_params": {"lr": 1e-3},
+        "total_epochs": 2,
+    }
+
+    def make_trainer(workdir):
+        return Trainer(
+            get_model("lenet5", num_classes=10), cfg, mesh8,
+            lambda e: batches(imgs, labels, 16,
+                              rng=np.random.default_rng(e)),
+            lambda: batches(imgs, labels, 16, drop_remainder=False),
+            workdir=workdir, steps_per_epoch=4, log_every=0,
+        )
+
+    t_straight = make_trainer(tmp_path / "a")
+    t_straight.fit(2)
+    want = {
+        k: t_straight.loggers.data[k]["value"][-1]
+        for k in ("train_loss", "val_loss", "val_top1")
+    }
+    t_straight.ckpt.close()
+
+    t1 = make_trainer(tmp_path / "b")
+    t1.fit(1)
+    t1.ckpt.close()
+    t2 = make_trainer(tmp_path / "b")
+    t2.resume()
+    assert t2.start_epoch == 1
+    t2.fit(2)
+    got = {
+        k: t2.loggers.data[k]["value"][-1]
+        for k in ("train_loss", "val_loss", "val_top1")
+    }
+    t2.ckpt.close()
+    for k in want:
+        assert got[k] == pytest.approx(want[k], rel=1e-6), k
