@@ -55,6 +55,7 @@ def make_cyclegan_dataset(
     *,
     is_training: bool = True,
     shuffle_buffer: int = 1000,
+    seed: int = 0,
 ):
     """Unpaired zip of the two domains; the shorter domain repeats so one
     epoch covers the longer one (the ref zips raw, truncating to the
@@ -64,12 +65,13 @@ def make_cyclegan_dataset(
 
     def one(pattern):
         files = tf.data.Dataset.list_files(pattern, shuffle=is_training,
-                                           seed=0)
+                                           seed=seed)
         ds = tf.data.TFRecordDataset(
             files, num_parallel_reads=tf.data.AUTOTUNE
         )
         if is_training:
-            ds = ds.shuffle(shuffle_buffer).repeat()
+            # epoch-seeded: deterministic order restore across resumes
+            ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
         return ds.map(prep, num_parallel_calls=tf.data.AUTOTUNE)
 
     ds = tf.data.Dataset.zip((one(pattern_a), one(pattern_b)))
@@ -86,7 +88,8 @@ def make_cyclegan_data(
 
     def train_data(epoch: int):
         ds = make_cyclegan_dataset(
-            str(d / "trainA-*"), str(d / "trainB-*"), batch_size, size
+            str(d / "trainA-*"), str(d / "trainB-*"), batch_size, size,
+            seed=epoch,
         )
         return iter_tf_batches(ds, ("a", "b"), limit=steps_per_epoch)
 

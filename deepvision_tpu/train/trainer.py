@@ -99,8 +99,9 @@ class Trainer:
         self.ckpt = CheckpointManager(self.workdir / "ckpt")
         self.start_epoch = 0
         self.best_metric = -float("inf")
+        # per-epoch stream derived in train_epoch: _key is only valid
+        # inside an epoch
         self._base_key = jax.random.key(seed + 1)
-        self._key = self._base_key
 
     # -- resume ----------------------------------------------------------
     def resume(self, epoch: int | None = None) -> None:
