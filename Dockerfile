@@ -13,10 +13,9 @@ ENV LC_ALL=C.UTF-8 \
     PYTHONUNBUFFERED=TRUE \
     PYTHONDONTWRITEBYTECODE=TRUE
 
-RUN pip install --no-cache-dir \
-    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    flax optax orbax-checkpoint chex einops numpy \
-    tensorflow-cpu google-cloud-storage
+COPY requirements.txt /tmp/requirements.txt
+RUN pip install --no-cache-dir -r /tmp/requirements.txt \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 
 WORKDIR /app
 COPY deepvision_tpu ./deepvision_tpu

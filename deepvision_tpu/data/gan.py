@@ -57,9 +57,12 @@ def make_cyclegan_dataset(
     shuffle_buffer: int = 1000,
     seed: int = 0,
 ):
-    """Unpaired zip of the two domains; the shorter domain repeats so one
-    epoch covers the longer one (the ref zips raw, truncating to the
-    shorter — we keep the standard unpaired semantics and document)."""
+    """Unpaired zip of the two domains. In training mode both domains
+    ``repeat()``, so the shorter one cycles and an epoch covers the longer
+    one (standard unpaired semantics; the ref zips raw, truncating to the
+    shorter). In eval mode (``is_training=False``) the zip IS raw and
+    truncates to the shorter domain — matching the reference's inference
+    behavior."""
     tf = _tf()
     prep = _parse_and_augment(size, is_training)
 

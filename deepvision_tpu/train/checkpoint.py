@@ -72,12 +72,71 @@ class CheckpointManager:
     def latest_epoch(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, state, epoch: int | None = None):
-        """-> (state, meta dict with 'epoch', 'loggers', 'extra')."""
+    def _resolve_epoch(self, epoch: int | None) -> int:
         if epoch is None:
             epoch = self._mgr.latest_step()
         if epoch is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return epoch
+
+    @staticmethod
+    def _decode_meta(meta) -> dict:
+        meta = dict(meta)
+        if meta.get("loggers"):
+            meta["loggers"] = Loggers.from_json(meta["loggers"])
+        return meta
+
+    def restore_inference(self, state, epoch: int | None = None):
+        """Params/batch_stats/step-only restore for inference.
+
+        Skips ``opt_state`` (and GAN pools), so the template never has to
+        reconstruct the exact optimizer the checkpoint was trained with —
+        restoring a Trainer checkpoint into an inference-built state works
+        regardless of schedule/plateau wrappers. -> (state, meta dict).
+        """
+        epoch = self._resolve_epoch(epoch)
+        template = {"params": state.params, "step": state.step}
+        if state.batch_stats:
+            template["batch_stats"] = state.batch_stats
+        # A fresh manager: on an instance that already save()d, the 'state'
+        # item is registered with the Standard handler and PyTreeRestore
+        # args would be rejected (orbax 0.11 registry semantics).
+        mgr = ocp.CheckpointManager(self.directory)
+        try:
+            restored = mgr.restore(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(
+                        item=template,
+                        # template shardings, NOT the on-disk sharding file:
+                        # a chip/mesh-saved checkpoint must restore on a
+                        # single-device inference host
+                        restore_args=ocp.checkpoint_utils.construct_restore_args(
+                            template
+                        ),
+                        partial_restore=True,
+                    ),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
+        finally:
+            mgr.close()
+        state = state.replace(**restored["state"])
+        return state, self._decode_meta(restored["meta"])
+
+    def restore_meta(self, epoch: int | None = None) -> dict:
+        """Restore only the JSON meta item (epoch/loggers/extra) through
+        the manager API — no state template needed, no dependence on the
+        Orbax on-disk layout."""
+        epoch = self._resolve_epoch(epoch)
+        restored = self._mgr.restore(
+            epoch, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )
+        return self._decode_meta(restored["meta"])
+
+    def restore(self, state, epoch: int | None = None):
+        """-> (state, meta dict with 'epoch', 'loggers', 'extra')."""
+        epoch = self._resolve_epoch(epoch)
         template = self._payload(state)
         restored = self._mgr.restore(
             epoch,
@@ -86,11 +145,8 @@ class CheckpointManager:
                 meta=ocp.args.JsonRestore(),
             ),
         )
-        payload, meta = restored["state"], dict(restored["meta"])
-        state = state.replace(**payload)
-        if meta.get("loggers"):
-            meta["loggers"] = Loggers.from_json(meta["loggers"])
-        return state, meta
+        state = state.replace(**restored["state"])
+        return state, self._decode_meta(restored["meta"])
 
     def close(self):
         self._mgr.close()

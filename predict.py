@@ -100,13 +100,16 @@ def load_state(model_name: str, workdir: str | None, sample, **model_kw):
     from deepvision_tpu.train.state import create_train_state
 
     model = get_model(model_name, dtype=jnp.float32, **model_kw)
+    # Throwaway tx: restore_inference never touches opt_state, so the
+    # template needn't match the training optimizer (which varies per
+    # config: momentum SGD, adam, plateau-wrapped schedules).
     state = create_train_state(model, optax.sgd(0.1), sample)
     if workdir and Path(f"{workdir}/ckpt").exists():
         from deepvision_tpu.train.checkpoint import CheckpointManager
 
         mgr = CheckpointManager(f"{workdir}/ckpt")
         if mgr.latest_epoch() is not None:
-            state, meta = mgr.restore(state)
+            state, meta = mgr.restore_inference(state)
             print(f"restored epoch {meta['epoch']} from {workdir}/ckpt")
             mgr.close()
             return state
@@ -221,7 +224,7 @@ def cmd_dcgan(args):
     if ckpt.exists():
         mgr = CheckpointManager(ckpt)
         if mgr.latest_epoch() is not None:
-            state, meta = mgr.restore(state)
+            state, meta = mgr.restore_inference(state)
             print(f"restored epoch {meta['epoch']}")
         mgr.close()
     n = args.n
@@ -252,7 +255,7 @@ def cmd_cyclegan(args):
     if ckpt.exists():
         mgr = CheckpointManager(ckpt)
         if mgr.latest_epoch() is not None:
-            state, meta = mgr.restore(state)
+            state, meta = mgr.restore_inference(state)
             print(f"restored epoch {meta['epoch']}")
         mgr.close()
     out = np.asarray(cyclegan_translate(state, img, args.direction))[0]
@@ -276,19 +279,12 @@ def cmd_curves(args):
     if epoch is None:
         sys.exit(f"no checkpoints under {args.workdir}/ckpt")
     # read only the JSON meta (loggers live there, not in the state)
-    import json as _json
-
-    meta_path = (
-        Path(mgr.directory) / str(epoch) / "meta" / "metadata"
-    )
-    meta = _json.loads(meta_path.read_text())
+    meta = mgr.restore_meta(epoch)
     mgr.close()
-    from deepvision_tpu.train.loggers import Loggers
-
-    loggers = Loggers.from_json(meta["loggers"])
-    metrics = sorted(loggers.data)
-    if not metrics:
+    loggers = meta["loggers"]
+    if loggers is None or not loggers.data:
         sys.exit("checkpoint has no logged metrics")
+    metrics = sorted(loggers.data)
     cols = 2
     rows = (len(metrics) + cols - 1) // cols
     fig, axes = plt.subplots(rows, cols, figsize=(10, 3 * rows),

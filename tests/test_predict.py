@@ -94,6 +94,113 @@ def test_predict_classify_runs(tmp_path, capsys):
     assert "%" in out
 
 
+def test_predict_restores_trainer_checkpoint(tmp_path, capsys, mesh8):
+    """Regression: load_state must restore checkpoints saved by the REAL
+    training configs (plateau-wrapped optimizers), whose opt_state trees
+    never match an inference-built sgd template. restore_inference skips
+    opt_state entirely, so any Trainer checkpoint loads."""
+    import predict
+    from deepvision_tpu.data.mnist import batches, synthetic_mnist
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.configs import get_config
+    from deepvision_tpu.train.trainer import Trainer
+
+    imgs, labels = synthetic_mnist(128)
+    cfg = get_config("lenet5")
+    cfg["batch_size"] = 64
+    rng = np.random.default_rng(0)
+    trainer = Trainer(
+        get_model("lenet5"), cfg, mesh8,
+        lambda e: batches(imgs[64:], labels[64:], 64, rng=rng),
+        lambda: batches(imgs[:64], labels[:64], 64),
+        workdir=tmp_path, steps_per_epoch=1, log_every=0,
+    )
+    trainer.fit(1)
+    trained_params = trainer.state.params
+    workdir = trainer.workdir  # Trainer nests under the config name
+
+    img = tmp_path / "img.jpg"
+    _write_test_image(img)
+    predict.main([
+        "classify", "-m", "lenet5", "--workdir", str(workdir),
+        str(img), "--num-classes", "10",
+    ])
+    out = capsys.readouterr().out
+    assert "restored epoch 0" in out
+    assert "freshly initialized" not in out
+
+    # the restored state actually carries the trained weights
+    state = predict.load_state(
+        "lenet5", str(workdir), np.zeros((1, 32, 32, 1), np.float32),
+        num_classes=10,
+    )
+    import jax
+
+    jax.tree.map(
+        np.testing.assert_allclose, state.params,
+        jax.tree.map(np.asarray, trained_params),
+    )
+
+    # Cross-topology: the mesh8-saved checkpoint must restore on a host
+    # with ONE device (the real predict.py deployment), i.e. the restore
+    # must use the template's shardings, not the on-disk sharding file.
+    import os
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');\n"
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np, predict\n"
+        "assert jax.device_count() == 1, jax.devices()\n"
+        "state = predict.load_state('lenet5', %r,\n"
+        "    np.zeros((1, 32, 32, 1), np.float32), num_classes=10)\n"
+        "print('SUBPROC-RESTORE-OK')\n"
+        % (str(Path(__file__).parent.parent), str(workdir))
+    )
+    out = subprocess.run([_sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert "SUBPROC-RESTORE-OK" in out.stdout, out.stderr[-2000:]
+
+
+def test_restore_inference_ignores_optimizer_mismatch(tmp_path):
+    """Regression (advisor medium): a CycleGAN checkpoint trained with a
+    linear_decay schedule must restore into a default-lr inference state —
+    adam's ScaleByScheduleState vs EmptyState no longer matters because
+    opt_state is never part of the inference template."""
+    import jax
+    import numpy as np
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.gan import create_cyclegan_state
+    from deepvision_tpu.train.schedules import linear_decay
+
+    g = get_model("cyclegan_generator")
+    d = get_model("cyclegan_discriminator")
+    sched = linear_decay(2e-4, total_steps=10, decay_start=5)
+    trained = create_cyclegan_state(g, d, image_size=32,
+                                    lr_schedule=sched, rng=1)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(0, trained)
+
+    # same-instance save → restore_inference must work too (the Standard
+    # handler registered by save() must not poison the partial restore)
+    fresh = create_cyclegan_state(g, d, image_size=32, rng=2)
+    restored, meta = mgr.restore_inference(fresh)
+    mgr.close()
+    assert meta["epoch"] == 0
+    jax.tree.map(
+        np.testing.assert_allclose,
+        jax.tree.map(np.asarray, restored.params),
+        jax.tree.map(np.asarray, trained.params),
+    )
+
+
 def test_predict_detect_draws(tmp_path, capsys):
     import predict
 
