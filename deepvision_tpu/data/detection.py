@@ -106,15 +106,6 @@ def random_crop(image, boxes, seed=None):
         dy2 = tf.random.uniform([], 0.0, tf.maximum(1.0 - max_ymax, 1e-6))
         sx = 1.0 - dx1 - dx2
         sy = 1.0 - dy1 - dy2
-        new_boxes = tf.stack(
-            [
-                (boxes[:, 0] - dx1) / sx,
-                (boxes[:, 1] - dy1) / sy,
-                (boxes[:, 2] - dx1) / sx,
-                (boxes[:, 3] - dy1) / sy,
-            ],
-            axis=-1,
-        )
         h = tf.cast(tf.shape(image)[0], tf.float32)
         w = tf.cast(tf.shape(image)[1], tf.float32)
         oh = tf.cast(dy1 * h, tf.int32)
@@ -123,6 +114,27 @@ def random_crop(image, boxes, seed=None):
         tw = tf.cast(tf.math.ceil(sx * w), tf.int32)
         th = tf.minimum(th, tf.shape(image)[0] - oh)
         tw = tf.minimum(tw, tf.shape(image)[1] - ow)
+        # Renormalize boxes to the ACTUAL pixel window, not the fractional
+        # draw: floor/ceil rounding above skews the window by up to a pixel
+        # vs (dx1, sx), which drifted boxes on small images. The reference
+        # computes both image and boxes in pixel space
+        # (ref: preprocess.py:52-119); deriving the fractions back from
+        # (ow, oh, tw, th) is the same arithmetic. The floor on the offsets
+        # can only move the window outward on the min side, but the ceil'd
+        # extent is clamped to the image, so clip the far edge to 1.
+        fx1 = tf.cast(ow, tf.float32) / w
+        fy1 = tf.cast(oh, tf.float32) / h
+        fsx = tf.cast(tw, tf.float32) / w
+        fsy = tf.cast(th, tf.float32) / h
+        new_boxes = tf.stack(
+            [
+                (boxes[:, 0] - fx1) / fsx,
+                (boxes[:, 1] - fy1) / fsy,
+                tf.minimum((boxes[:, 2] - fx1) / fsx, 1.0),
+                tf.minimum((boxes[:, 3] - fy1) / fsy, 1.0),
+            ],
+            axis=-1,
+        )
         return image[oh : oh + th, ow : ow + tw, :], new_boxes
 
     return tf.cond(crop, do_crop, lambda: (image, boxes))

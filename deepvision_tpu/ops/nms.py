@@ -20,25 +20,34 @@ import jax.numpy as jnp
 
 from deepvision_tpu.ops.iou import broadcast_iou
 
+# Default greedy-suppression working-set bound; eval code compares the
+# runtime candidate count against this same constant (the tripwire).
+NMS_CANDIDATE_CAP = 512
+
 
 def nms_indices(
     boxes, scores, *, iou_thresh: float = 0.5, score_thresh: float = 0.5,
-    max_out: int = 100, candidate_cap: int = 512,
+    max_out: int = 100, candidate_cap: int = NMS_CANDIDATE_CAP,
 ):
     """boxes (N,4) corners, scores (N,) ->
-    (idx (K,) int32 into the input, scores (K,), valid (K,) bool), K=max_out.
-    Survivors are compacted to the front in score order; padded slots have
-    valid=False, score=0, idx=0.
+    (idx (K,) int32 into the input, scores (K,), valid (K,) bool,
+    n_candidates () int32), K=max_out. Survivors are compacted to the
+    front in score order; padded slots have valid=False, score=0, idx=0.
 
     Greedy suppression runs over the top-``candidate_cap`` scored boxes
     (bounding the IoU matrix at cap², the fixed-shape price of XLA), then
     the first ``max_out`` survivors are emitted. Exact greedy-NMS parity
     holds whenever at most ``candidate_cap`` boxes clear ``score_thresh`` —
     size it accordingly (default 512 ≫ the reference's 100 detections,
-    ref: postprocess.py:38-96).
+    ref: postprocess.py:38-96). ``n_candidates`` is the runtime tripwire
+    for that condition: the number of boxes that actually cleared
+    ``score_thresh``. Whenever it exceeds ``candidate_cap`` (plausible
+    early in training while objectness is uncalibrated), exactness has
+    silently degraded — eval surfaces it as a metric.
     """
     n = boxes.shape[0]
     k = min(n, max(candidate_cap, max_out))
+    n_candidates = jnp.sum(scores >= score_thresh).astype(jnp.int32)
     masked = jnp.where(scores >= score_thresh, scores, -jnp.inf)
     top_scores, top_idx = jax.lax.top_k(masked, k)
     iou = broadcast_iou(boxes[top_idx], boxes[top_idx])  # (k, k)
@@ -57,26 +66,27 @@ def nms_indices(
         idx = jnp.pad(idx, (0, pad))
         out_scores = jnp.pad(out_scores, (0, pad))
         valid = jnp.pad(valid, (0, pad))
-    return idx, out_scores, valid
+    return idx, out_scores, valid, n_candidates
 
 
 def batched_nms(boxes, scores, classes, *, iou_thresh=0.5, score_thresh=0.5,
-                max_out=100, candidate_cap=512):
+                max_out=100, candidate_cap=NMS_CANDIDATE_CAP):
     """Class-agnostic greedy suppression over a batch (the reference's
     Postprocessor behavior — ref: postprocess.py:6-96).
 
     boxes (B,N,4), scores (B,N), classes (B,N) ->
-    (boxes (B,K,4), scores (B,K), classes (B,K), valid (B,K)).
+    (boxes (B,K,4), scores (B,K), classes (B,K), valid (B,K),
+    n_candidates (B,) — see :func:`nms_indices` on the exactness tripwire).
     """
 
     def one(b, s, c):
-        idx, out_scores, valid = nms_indices(
+        idx, out_scores, valid, n_cand = nms_indices(
             b, s, iou_thresh=iou_thresh, score_thresh=score_thresh,
             max_out=max_out, candidate_cap=candidate_cap,
         )
         zero = jnp.zeros_like(valid)
         out_boxes = jnp.where(valid[:, None], b[idx], 0.0)
         out_classes = jnp.where(valid, c[idx], zero.astype(c.dtype))
-        return out_boxes, out_scores, out_classes, valid
+        return out_boxes, out_scores, out_classes, valid, n_cand
 
     return jax.vmap(one)(boxes, scores, classes)

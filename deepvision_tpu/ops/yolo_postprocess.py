@@ -22,7 +22,8 @@ def yolo_postprocess(
     iou_thresh: float = 0.5, score_thresh: float = 0.5, max_out: int = 100,
 ):
     """Raw grids ((B,S,S,3,5+C) ×3) ->
-    (boxes (B,K,4) corners, scores (B,K), classes (B,K), valid (B,K)).
+    (boxes (B,K,4) corners, scores (B,K), classes (B,K), valid (B,K),
+    n_candidates (B,) — NMS exactness tripwire, see ops.nms.nms_indices).
 
     Score = objectness (ref: postprocess.py:28-30); the reported class is
     the argmax class probability of the surviving box.

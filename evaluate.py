@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 
 import numpy as np
 
@@ -50,7 +51,12 @@ def cmd_classification(args):
     if args.data_dir and cfg["dataset"] == "imagenet":
         from deepvision_tpu.data.imagenet import make_imagenet_data
 
-        _, val_data, _ = make_imagenet_data(args.data_dir, bs, size)
+        # evaluation must use the config's normalization lineage: a
+        # pt-lineage net expects torchvision mean/std inputs, not the TF
+        # mean subtraction (same wiring as train.py)
+        _, val_data, _ = make_imagenet_data(
+            args.data_dir, bs, size, augment=cfg.get("augment", "tf")
+        )
         batches = val_data()
     elif args.data_dir and cfg["dataset"] == "mnist":
         import os
@@ -77,7 +83,12 @@ def cmd_classification(args):
 
     mesh = create_mesh()
     state = None
-    step = compile_eval_step(classification_eval_step, mesh)
+    eval_fn = classification_eval_step
+    if cfg.get("augment") == "pt":  # uint8 batches need torch stats
+        from functools import partial
+
+        eval_fn = partial(classification_eval_step, normalize_kind="torch")
+    step = compile_eval_step(eval_fn, mesh)
 
     def parts():
         nonlocal state
@@ -127,13 +138,17 @@ def cmd_detection(args):
 
     state = None
     dets, gts = [], []
+    nms_candidates_max = 0  # NMS exactness tripwire (ops/nms.py)
     for batch in batches:
         if state is None:
             state = _load(args.model, args.workdir, batch["image"][:1],
                           num_classes=num_classes)
         preds = _apply(state, batch["image"])
-        b_boxes, b_scores, b_cls, b_valid = yolo_postprocess(
+        b_boxes, b_scores, b_cls, b_valid, b_ncand = yolo_postprocess(
             preds, num_classes, score_thresh=args.score
+        )
+        nms_candidates_max = max(
+            nms_candidates_max, int(np.asarray(b_ncand).max())
         )
         b_boxes = np.asarray(b_boxes)
         b_scores, b_cls = np.asarray(b_scores), np.asarray(b_cls)
@@ -158,9 +173,18 @@ def cmd_detection(args):
         names[c]: round(float(out["ap"][c]), 4)
         for c in range(num_classes) if np.isfinite(out["ap"][c])
     }
+    from deepvision_tpu.ops.nms import NMS_CANDIDATE_CAP as nms_cap
+
+    if nms_candidates_max > nms_cap:
+        print(f"# WARNING: {nms_candidates_max} candidates cleared the "
+              f"score threshold (> candidate_cap={nms_cap}); greedy-NMS "
+              "exactness degraded — raise candidate_cap or score_thresh.",
+              file=sys.stderr)
     print(json.dumps({
         "metric": "mAP", "iou": args.iou, "value": round(out["map"], 4),
         "images": len(dets), "per_class": per_class,
+        "nms_candidates_max": nms_candidates_max,
+        "nms_exact": nms_candidates_max <= nms_cap,
     }))
 
 

@@ -33,7 +33,8 @@ import numpy as np
 
 
 def load_image(path: str, size: int, *, scale: str) -> np.ndarray:
-    """JPEG/PNG → (1, size, size, 3) f32; scale: 'imagenet' | 'tanh'."""
+    """JPEG/PNG → (1, size, size, 3) f32;
+    scale: 'imagenet' | 'torch' | 'unit' | 'tanh'."""
     import tensorflow as tf
 
     tf.config.set_visible_devices([], "GPU")
@@ -45,6 +46,14 @@ def load_image(path: str, size: int, *, scale: str) -> np.ndarray:
         from deepvision_tpu.ops.normalize import IMAGENET_CHANNEL_MEANS
 
         img = img - np.asarray(IMAGENET_CHANNEL_MEANS, np.float32)
+    elif scale == "torch":  # torchvision mean/std (PT-lineage configs)
+        from deepvision_tpu.ops.normalize import (
+            TORCH_CHANNEL_MEANS,
+            TORCH_CHANNEL_STDS,
+        )
+
+        img = (img / 255.0 - np.asarray(TORCH_CHANNEL_MEANS, np.float32)) \
+            / np.asarray(TORCH_CHANNEL_STDS, np.float32)
     elif scale == "unit":  # [0,1] (the MNIST-family loaders)
         img = img / 255.0
     else:
@@ -141,7 +150,13 @@ def cmd_classify(args):
     from deepvision_tpu.data.metadata import imagenet_label_name
 
     size, channels = _model_geometry(args.model)
-    scale = "unit" if channels == 1 else "imagenet"
+    from deepvision_tpu.train.configs import TRAINING_CONFIG
+
+    lineage = TRAINING_CONFIG.get(
+        args.model.removesuffix("_ref"), {}
+    ).get("augment", "tf")
+    scale = ("unit" if channels == 1
+             else "torch" if lineage == "pt" else "imagenet")
     imgs = [load_image(p, size, scale=scale) for p in args.images]
     if channels == 1:  # grayscale nets (lenet5)
         imgs = [img.mean(axis=-1, keepdims=True) for img in imgs]
@@ -172,7 +187,7 @@ def cmd_detect(args):
     state = load_state(args.model, args.workdir, img,
                        num_classes=len(names))
     preds = _apply(state, img)
-    boxes, scores, classes, valid = yolo_postprocess(
+    boxes, scores, classes, valid, _ = yolo_postprocess(
         preds, len(names), score_thresh=args.score
     )
     boxes = np.asarray(boxes)[0] * args.size  # corners (x1,y1,x2,y2)

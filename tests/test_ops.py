@@ -126,7 +126,7 @@ def test_nms_matches_greedy_reference(seed):
     n = 40
     boxes = _random_boxes(rng, n)
     scores = rng.uniform(0.0, 1.0, size=n).astype(np.float32)
-    idx, out_scores, valid = nms_indices(
+    idx, out_scores, valid, _ = nms_indices(
         boxes, scores, iou_thresh=0.5, score_thresh=0.3, max_out=n
     )
     got = list(np.asarray(idx)[np.asarray(valid)])
@@ -144,7 +144,7 @@ def test_nms_tied_scores_deterministic():
         np.float32,
     )
     scores = np.array([0.9, 0.9, 0.9], np.float32)  # all tied
-    idx, _, valid = nms_indices(
+    idx, _, valid, _ = nms_indices(
         boxes, scores, iou_thresh=0.5, score_thresh=0.1, max_out=3
     )
     got = list(np.asarray(idx)[np.asarray(valid)])
@@ -155,7 +155,7 @@ def test_nms_tied_scores_deterministic():
 def test_nms_padding_contract():
     boxes = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
     scores = np.array([0.9], np.float32)
-    idx, out_scores, valid = nms_indices(
+    idx, out_scores, valid, _ = nms_indices(
         boxes, scores, iou_thresh=0.5, score_thresh=0.5, max_out=5
     )
     assert idx.shape == (5,) and out_scores.shape == (5,)
@@ -166,7 +166,7 @@ def test_nms_padding_contract():
 def test_nms_all_below_score_thresh():
     boxes = _random_boxes(np.random.default_rng(0), 8)
     scores = np.full(8, 0.1, np.float32)
-    _, out_scores, valid = nms_indices(
+    _, out_scores, valid, _ = nms_indices(
         boxes, scores, iou_thresh=0.5, score_thresh=0.5, max_out=8
     )
     assert not np.asarray(valid).any()
@@ -186,7 +186,7 @@ def test_nms_max_out_truncates():
         axis=-1,
     )
     scores = rng.uniform(0.6, 1.0, size=10).astype(np.float32)
-    idx, _, valid = nms_indices(
+    idx, _, valid, _ = nms_indices(
         boxes, scores, iou_thresh=0.5, score_thresh=0.5, max_out=4
     )
     got = list(np.asarray(idx)[np.asarray(valid)])
@@ -199,7 +199,7 @@ def test_batched_nms_shapes_and_zeroed_padding(rng):
     boxes = np.stack([_random_boxes(rng, n) for _ in range(b)])
     scores = rng.uniform(0, 1, size=(b, n)).astype(np.float32)
     classes = rng.integers(0, 5, size=(b, n)).astype(np.int32)
-    ob, os_, oc, valid = batched_nms(
+    ob, os_, oc, valid, _ = batched_nms(
         boxes, scores, classes, iou_thresh=0.5, score_thresh=0.4, max_out=k
     )
     assert ob.shape == (b, k, 4) and os_.shape == (b, k)
@@ -352,3 +352,14 @@ def test_lrn_pallas_odd_channels_and_tile_remainder():
         np.asarray(local_response_norm(x, impl="jnp")),
         atol=1e-5,
     )
+
+
+def test_nms_candidate_tripwire_counts_threshold_clearers(rng):
+    boxes = _random_boxes(rng, 12)
+    scores = np.concatenate([
+        np.full(5, 0.9, np.float32), np.full(7, 0.1, np.float32)
+    ])
+    *_, n_cand = nms_indices(
+        boxes, scores, iou_thresh=0.5, score_thresh=0.5, max_out=12
+    )
+    assert int(n_cand) == 5
