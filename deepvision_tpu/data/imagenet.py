@@ -26,11 +26,16 @@ from pathlib import Path
 import numpy as np
 
 from deepvision_tpu.data.padding import pad_partial_batch
-from deepvision_tpu.ops.normalize import (
-    IMAGENET_CHANNEL_MEANS as CHANNEL_MEANS,  # single source of truth
+from deepvision_tpu.ops.normalize import (  # single source of truth
+    IMAGENET_CHANNEL_MEANS as CHANNEL_MEANS,
+    TORCH_CHANNEL_MEANS as TORCH_MEANS,
+    TORCH_CHANNEL_STDS as TORCH_STDS,
 )
 
 RESIZE_MIN = 256
+# PT-canonical augmentation strength (ref: ResNet/pytorch/train.py:319 —
+# ColorJitter(brightness=0.2, contrast=0.2, saturation=0.2, hue=0))
+PT_JITTER = 0.2
 
 
 def resize_min_for(size: int) -> int:
@@ -47,16 +52,54 @@ def _tf():
     return tf
 
 
+def color_jitter(image, fb, fc, fs):
+    """PIL-enhance-semantics jitter on a [0,255] f32 image with explicit
+    factors (brightness, contrast, saturation) — the deterministic core of
+    the PT reference's ColorJitter (ref: ResNet/pytorch/data_load.py:213-296),
+    kept factor-for-factor identical to the numpy twin
+    (data/transforms.ColorJitter) so the two pipelines are parity-testable.
+    """
+    tf = _tf()
+    coeffs = tf.constant([0.299, 0.587, 0.114], tf.float32)
+    img = image * fb
+    gray = tf.tensordot(img, coeffs, 1)
+    img = tf.reduce_mean(gray) * (1.0 - fc) + img * fc
+    gray = tf.tensordot(img, coeffs, 1)[..., None]
+    img = gray * (1.0 - fs) + img * fs
+    return img
+
+
+def _random_jitter(image, amount: float):
+    """Sample PIL-enhance factors in [max(0, 1−a), 1+a] (transforms.py
+    twin semantics) and apply; rounds through uint8 range like PIL does."""
+    tf = _tf()
+    lo = max(0.0, 1.0 - amount)
+    fb, fc, fs = (
+        tf.random.uniform([], lo, 1.0 + amount) for _ in range(3)
+    )
+    img = color_jitter(image, fb, fc, fs)
+    return tf.clip_by_value(tf.round(img), 0.0, 255.0)
+
+
 def parse_and_preprocess(serialized, size: int, is_training: bool,
-                         as_uint8: bool = False):
+                         as_uint8: bool = False, augment: str = "tf"):
     """One Example -> (image [size,size,3], int32 label).
 
     Default emits f32 mean-subtracted images (full reference parity).
-    ``as_uint8`` emits rounded uint8 crops WITHOUT mean subtraction — 4×
-    less host↔device wire traffic; the train step applies
-    ``ops.normalize.imagenet_normalize`` on device (TPU-first: HBM
-    bandwidth is cheaper than host link bandwidth).
+    ``as_uint8`` emits rounded uint8 crops WITHOUT normalization — 4×
+    less host↔device wire traffic; the train step applies the matching
+    ``ops.normalize`` kind on device (TPU-first: HBM bandwidth is cheaper
+    than host link bandwidth).
+
+    ``augment`` selects the reference lineage:
+      - ``"tf"``: crop/flip + channel-mean subtraction
+        (ref: ResNet/tensorflow/data_load.py:35-193);
+      - ``"pt"``: adds ColorJitter(0.2, 0.2, 0.2) in training and
+        normalizes with the torchvision mean/std — the PT configs'
+        accuracy-canonical recipe (ref: ResNet/pytorch/train.py:315-324).
     """
+    if augment not in ("tf", "pt"):
+        raise ValueError(f"unknown augment lineage {augment!r}")
     tf = _tf()
     feats = tf.io.parse_single_example(
         serialized,
@@ -80,6 +123,8 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     if is_training:
         image = tf.image.random_crop(image, [size, size, 3])
         image = tf.image.random_flip_left_right(image)
+        if augment == "pt":
+            image = _random_jitter(image, PT_JITTER)
     else:
         # central crop (ref: data_load.py _central_crop)
         off_h = (new_h - size) // 2
@@ -88,6 +133,9 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     if as_uint8:
         image = tf.cast(tf.clip_by_value(tf.round(image), 0.0, 255.0),
                         tf.uint8)
+    elif augment == "pt":
+        image = (image / 255.0 - tf.constant(TORCH_MEANS, tf.float32)) \
+            / tf.constant(TORCH_STDS, tf.float32)
     else:
         image = image - tf.constant(CHANNEL_MEANS, tf.float32)
 
@@ -105,6 +153,7 @@ def make_dataset(
     num_process: int = 1,
     process_index: int = 0,
     as_uint8: bool = False,
+    augment: str = "tf",
     seed: int = 0,
 ):
     """tf.data pipeline over sharded TFRecords; per-host file sharding for
@@ -124,7 +173,8 @@ def make_dataset(
         # deterministic data-order restore the reference lacks)
         ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
     ds = ds.map(
-        lambda s: parse_and_preprocess(s, size, is_training, as_uint8),
+        lambda s: parse_and_preprocess(s, size, is_training, as_uint8,
+                                       augment),
         num_parallel_calls=tf.data.AUTOTUNE,
     )
     ds = ds.batch(batch_size, drop_remainder=is_training)
@@ -148,7 +198,7 @@ def _as_batches(ds, limit: int | None = None, pad_to: int | None = None):
 def make_imagenet_data(
     data_dir: str, batch_size: int, size: int = 224,
     *, train_images: int = 1_281_167, val_images: int = 50_000,
-    train_as_uint8: bool = True,
+    train_as_uint8: bool = True, augment: str = "tf",
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
@@ -179,6 +229,7 @@ def make_imagenet_data(
         # the locals into the global array (local × nproc = global).
         ds = make_dataset(str(d / "train-*"), local_bs, size,
                           is_training=True, as_uint8=train_as_uint8,
+                          augment=augment,
                           num_process=nproc, process_index=pid,
                           seed=epoch)
         return _as_batches(ds, steps)
@@ -191,7 +242,7 @@ def make_imagenet_data(
         # counts always agree, coverage stays exact (final partial batch
         # padded + masked).
         ds = make_dataset(str(d / "validation-*"), batch_size, size,
-                          is_training=False)
+                          is_training=False, augment=augment)
         for batch in _as_batches(ds, pad_to=batch_size):
             yield {
                 k: v[pid * local_bs:(pid + 1) * local_bs]

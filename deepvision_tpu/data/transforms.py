@@ -108,6 +108,20 @@ class Normalize:
         return (image - self.mean) / self.std
 
 
+def apply_color_jitter(img: np.ndarray, fb: float, fc: float, fs: float):
+    """Deterministic PIL-enhance-semantics core on f32: brightness scale,
+    contrast blend with the grayscale mean, saturation blend per pixel.
+    The tf.data twin (data/imagenet.color_jitter) mirrors this
+    factor-for-factor — parity pinned in tests."""
+    coeffs = np.array([0.299, 0.587, 0.114], np.float32)
+    img = img * fb
+    gray = img @ coeffs
+    img = gray.mean() * (1 - fc) + img * fc
+    gray = (img @ coeffs)[..., None]
+    img = gray * (1 - fs) + img * fs
+    return img
+
+
 class ColorJitter:
     """brightness/contrast/saturation jitter with PIL-enhance semantics
     (factor sampled in [max(0, 1-x), 1+x])."""
@@ -122,18 +136,10 @@ class ColorJitter:
         return float(rng.uniform(max(0.0, 1 - amount), 1 + amount))
 
     def __call__(self, rng, image):
-        img = image.astype(np.float32)
-        if self.brightness:
-            img = img * self._factor(rng, self.brightness)
-        if self.contrast:
-            f = self._factor(rng, self.contrast)
-            # PIL Contrast: blend with the mean of the grayscale image
-            gray = img @ np.array([0.299, 0.587, 0.114], np.float32)
-            img = gray.mean() * (1 - f) + img * f
-        if self.saturation:
-            f = self._factor(rng, self.saturation)
-            gray = (img @ np.array([0.299, 0.587, 0.114], np.float32))[..., None]
-            img = gray * (1 - f) + img * f
+        fb = self._factor(rng, self.brightness) if self.brightness else 1.0
+        fc = self._factor(rng, self.contrast) if self.contrast else 1.0
+        fs = self._factor(rng, self.saturation) if self.saturation else 1.0
+        img = apply_color_jitter(image.astype(np.float32), fb, fc, fs)
         if image.dtype == np.uint8:
             return np.clip(img, 0, 255).astype(np.uint8)
         return img

@@ -13,12 +13,25 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 IMAGENET_CHANNEL_MEANS = (123.68, 116.78, 103.94)  # ref: data_load.py:35-38
+# torchvision ImageNet statistics — the PT reference's accuracy-canonical
+# normalization (ref: ResNet/pytorch/train.py:322-324)
+TORCH_CHANNEL_MEANS = (0.485, 0.456, 0.406)
+TORCH_CHANNEL_STDS = (0.229, 0.224, 0.225)
 
 
 def imagenet_normalize(images: jnp.ndarray) -> jnp.ndarray:
     """uint8 [0,255] → f32 channel-mean-subtracted (classification nets)."""
     return images.astype(jnp.float32) - jnp.asarray(
         IMAGENET_CHANNEL_MEANS, jnp.float32
+    )
+
+
+def torch_normalize(images: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [0,255] → f32 ((x/255) − mean)/std, the PT reference's
+    ToTensor + Normalize (ref: ResNet/pytorch/train.py:320-324)."""
+    x = images.astype(jnp.float32) / 255.0
+    return (x - jnp.asarray(TORCH_CHANNEL_MEANS, jnp.float32)) / jnp.asarray(
+        TORCH_CHANNEL_STDS, jnp.float32
     )
 
 
@@ -30,10 +43,12 @@ def tanh_normalize(images: jnp.ndarray) -> jnp.ndarray:
 
 def maybe_normalize(images: jnp.ndarray, kind: str = "imagenet"):
     """Normalize on device iff the batch arrived as uint8."""
-    if kind not in ("imagenet", "tanh"):
+    if kind not in ("imagenet", "tanh", "torch"):
         raise ValueError(f"unknown normalization kind {kind!r}")
     if images.dtype != jnp.uint8:
         return images
     if kind == "imagenet":
         return imagenet_normalize(images)
+    if kind == "torch":
+        return torch_normalize(images)
     return tanh_normalize(images)

@@ -26,6 +26,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: ResNet/pytorch/train.py:27-51 (SGD 0.01/0.9/5e-4, plateau max)
     "alexnet1": {
+        "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
         "optimizer": "sgd",
@@ -37,6 +38,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:52-73
     "alexnet2": {
+        "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
         "optimizer": "sgd",
@@ -48,6 +50,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:74-100 (StepLR 10/0.5)
     "vgg16": {
+        "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
         "optimizer": "sgd",
@@ -59,6 +62,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:101-117
     "vgg19": {
+        "augment": "pt",
         "batch_size": 64,
         "input_size": 224,
         "optimizer": "sgd",
@@ -70,6 +74,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:118-136 (poly decay lambda)
     "inception1": {
+        "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
         "optimizer": "sgd",
@@ -80,6 +85,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:137-163 (SGD 0.1/0.9/1e-4, plateau max, batch 256)
     "resnet34": {
+        "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
         "optimizer": "sgd",
@@ -91,6 +97,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:164-180 — the north-star accuracy config (73.93% top-1)
     "resnet50": {
+        "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
         "optimizer": "sgd",
@@ -101,6 +108,7 @@ TRAINING_CONFIG: dict[str, dict] = {
         "total_epochs": 200,
     },
     "resnet152": {
+        "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
         "optimizer": "sgd",
@@ -122,6 +130,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:181-214 (RMSprop 0.045/alpha .9/eps 1.0, StepLR 2/0.94)
     "mobilenet1": {
+        "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
         "optimizer": "rmsprop",
@@ -132,6 +141,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # reference WIP — config completed per the ShuffleNet paper (linear decay)
     "shufflenet1": {
+        "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
         "optimizer": "sgd",
@@ -239,7 +249,13 @@ TRAINING_CONFIG: dict[str, dict] = {
 
 
 def get_config(name: str) -> dict:
-    cfg = dict(TRAINING_CONFIG[name])
+    # "<model>_ref" = reference-exact architecture variant (converter
+    # parity, e.g. inception1_ref = BN-free BasicConv blocks); trains and
+    # evaluates with the base model's config
+    base = name
+    if name.endswith("_ref") and name[:-4] in TRAINING_CONFIG:
+        base = name[:-4]
+    cfg = dict(TRAINING_CONFIG[base])
     cfg.setdefault("input_size", 224)
     cfg.setdefault("channels", 3)
     cfg.setdefault("num_classes", 1000)

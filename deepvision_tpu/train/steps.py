@@ -24,10 +24,16 @@ from deepvision_tpu.train.state import TrainState
 
 
 def classification_train_step(
-    state: TrainState, batch: dict, key: jax.Array
+    state: TrainState, batch: dict, key: jax.Array,
+    normalize_kind: str = "imagenet",
 ) -> tuple[TrainState, dict]:
-    """One SGD step on {'image','label'}; returns (new_state, metrics)."""
-    images = maybe_normalize(batch["image"])
+    """One SGD step on {'image','label'}; returns (new_state, metrics).
+
+    ``normalize_kind`` must match the host pipeline's uint8 wire contract:
+    "imagenet" (TF-lineage mean subtraction) or "torch" (PT-lineage
+    mean/std — configs with ``augment: "pt"``); bind it with
+    ``functools.partial`` before compiling."""
+    images = maybe_normalize(batch["image"], normalize_kind)
     labels = batch["label"]
 
     def loss_fn(params):
@@ -126,7 +132,9 @@ def yolo_eval_step(state: TrainState, batch: dict) -> dict:
     }
 
 
-def classification_eval_step(state: TrainState, batch: dict) -> dict:
+def classification_eval_step(
+    state: TrainState, batch: dict, normalize_kind: str = "imagenet"
+) -> dict:
     """Count-weighted sums over one batch, for exact epoch aggregation.
 
     ``batch["mask"]`` (optional, (B,) float 1/0) marks padding rows: the
@@ -134,7 +142,7 @@ def classification_eval_step(state: TrainState, batch: dict) -> dict:
     whole 50k-image set is evaluated with one compiled shape — the
     reference evaluates the full set too (ref: ResNet/pytorch/train.py:488-520).
     """
-    images = maybe_normalize(batch["image"])
+    images = maybe_normalize(batch["image"], normalize_kind)
     labels = batch["label"]
     mask = batch.get("mask")
     if mask is None:

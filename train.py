@@ -49,6 +49,14 @@ def parse_args():
                    help="ZeRO-1-style optimizer-state sharding over the "
                         "data axis (arXiv:2004.13336); saves optimizer "
                         "memory per chip, identical numerics")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="overlap per-epoch Orbax saves with training "
+                        "(save() returns after staging to host)")
+    p.add_argument("--keep-best", action="store_true",
+                   help="retain the best checkpoints by the plateau "
+                        "metric instead of the most recent (the "
+                        "reference's save-on-new-best, "
+                        "ref: YOLO/tensorflow/train.py:243-257)")
     return p.parse_args()
 
 
@@ -160,7 +168,8 @@ def main():
         from deepvision_tpu.data.imagenet import make_imagenet_data
 
         train_data, val_data, steps = make_imagenet_data(
-            args.data_dir, cfg["batch_size"], size
+            args.data_dir, cfg["batch_size"], size,
+            augment=cfg.get("augment", "tf"),
         )
     elif args.data_dir and cfg["dataset"] == "mnist":
         import os
@@ -197,13 +206,32 @@ def main():
                                    cfg["batch_size"], drop_remainder=False)
         steps = (n - split) // cfg["batch_size"]
 
+    if not step_fns and cfg.get("augment") == "pt":
+        # PT-lineage configs ship uint8 crops; the on-device normalization
+        # must be the torchvision mean/std, not the TF mean subtraction.
+        from functools import partial
+
+        from deepvision_tpu.train.steps import (
+            classification_eval_step,
+            classification_train_step,
+        )
+
+        step_fns = {
+            "train_step": partial(classification_train_step,
+                                  normalize_kind="torch"),
+            "eval_step": partial(classification_eval_step,
+                                 normalize_kind="torch"),
+        }
+
     mesh = create_mesh()
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     trainer = Trainer(
         model, cfg, mesh, train_data, val_data,
         workdir=args.workdir, steps_per_epoch=steps,
         check_numerics=args.check_numerics,
-        shard_weight_update=args.shard_weight_update, **step_fns,
+        shard_weight_update=args.shard_weight_update,
+        async_checkpoint=args.async_checkpoint,
+        keep_best=args.keep_best, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
@@ -318,6 +346,7 @@ def run_gan(args, cfg, dtype):
         resume_epoch=args.checkpoint,
         check_numerics=args.check_numerics,
         shard_weight_update=args.shard_weight_update,
+        async_checkpoint=args.async_checkpoint,
     )
     _maybe_publish(args, f"{workdir}/ckpt")
 
