@@ -423,20 +423,21 @@ def fit_gan(
     resume_epoch: int | None = None,
     check_numerics: bool = False,
     shard_weight_update: bool = False,
+    async_checkpoint: bool = False,
 ):
     """Minimal GAN epoch loop: compiled step + loggers + TB + Orbax saves
     every ``save_every`` epochs keeping 3 (ref: DCGAN/tensorflow/main.py:39,
     80-83; CycleGAN saves every epoch with the epoch tracked in the
     checkpoint, ref: train.py:329-333 — pass save_every=1)."""
-    from deepvision_tpu.core import shard_batch
     from deepvision_tpu.core.step import (
         compile_checked_train_step,
         compile_train_step,
     )
+    from deepvision_tpu.data.device_put import device_prefetch
     from deepvision_tpu.train.checkpoint import CheckpointManager
     from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
 
-    mgr = CheckpointManager(f"{workdir}/ckpt")
+    mgr = CheckpointManager(f"{workdir}/ckpt", async_save=async_checkpoint)
     loggers = Loggers()
     tb = TensorBoardWriter(f"{workdir}/tb")
     start_epoch = 0
@@ -460,18 +461,33 @@ def fit_gan(
         # run's z draws / pool coin flips (same rationale as Trainer)
         key = jax.random.fold_in(base_key, epoch)
         t0 = time.time()
-        fetched = []
-        for i, batch in enumerate(train_data(epoch)):
+        # pending/drain split (same as Trainer.train_epoch): metrics stay
+        # device-side until a drain, so the dispatch queue keeps running —
+        # per-batch float() here serialized a D2H round trip per metric
+        # per batch and stalled the device between steps.
+        pending: list[dict] = []  # device scalars not yet fetched
+        fetched: list[dict] = []  # host floats; each metric fetched ONCE
+
+        def drain():
+            fetched.extend(
+                {k: float(v) for k, v in m.items()} for m in pending
+            )
+            pending.clear()
+
+        for i, device_batch in enumerate(
+            device_prefetch(train_data(epoch), mesh)
+        ):
             key, sub = jax.random.split(key)
-            state, metrics = step(state, shard_batch(mesh, batch), sub)
-            fetched.append(metrics)
+            state, metrics = step(state, device_batch, sub)
+            pending.append(metrics)
             if log_every and i % log_every == 0:
-                host = {k: float(v) for k, v in fetched[-1].items()}
+                drain()  # syncs mostly-finished work; O(n) fetches total
                 print(f"[epoch {epoch} batch {i}] " + " ".join(
-                    f"{k}={v:.4f}" for k, v in sorted(host.items())
+                    f"{k}={v:.4f}" for k, v in sorted(fetched[-1].items())
                 ), flush=True)
+        drain()  # drains the dispatch queue — MUST precede the timing read
         epoch_metrics = {
-            k: float(np.mean([float(m[k]) for m in fetched]))
+            k: float(np.mean([m[k] for m in fetched]))
             for k in (fetched[0] if fetched else {})
         }
         loggers.log_metrics(epoch, epoch_metrics)

@@ -55,6 +55,8 @@ class Trainer:
         seed: int = 0,
         check_numerics: bool = False,
         shard_weight_update: bool = False,
+        async_checkpoint: bool = False,
+        keep_best: bool = False,
     ):
         self.model = model
         self.config = config
@@ -96,7 +98,14 @@ class Trainer:
         )
         self.loggers = Loggers()
         self.tb = TensorBoardWriter(self.workdir / "tb")
-        self.ckpt = CheckpointManager(self.workdir / "ckpt")
+        # async: per-epoch saves overlap the next epoch's compute;
+        # keep_best: retention keyed on the plateau metric instead of
+        # recency (ref: YOLO/tensorflow/train.py:243-257 best-val save)
+        self.ckpt = CheckpointManager(
+            self.workdir / "ckpt",
+            async_save=async_checkpoint,
+            keep_best_of="plateau_metric" if keep_best else None,
+        )
         self.start_epoch = 0
         self.best_metric = -float("inf")
         # per-epoch stream derived in train_epoch: _key is only valid
@@ -233,7 +242,9 @@ class Trainer:
                 extra={"plateau": self.plateau.state_dict()}
                 if self.plateau else {},
                 best_metric=self.best_metric,
+                metrics={"plateau_metric": float(metric)},
             )
+        self.ckpt.wait_until_finished()  # commit any in-flight async save
         return self.loggers
 
 

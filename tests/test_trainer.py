@@ -145,3 +145,54 @@ def test_resume_reproduces_uninterrupted_run(tmp_path, mesh8):
     t2.ckpt.close()
     for k in want:
         assert got[k] == pytest.approx(want[k], rel=1e-6), k
+
+
+def test_async_checkpoint_saves_and_restores(tmp_path, mesh8):
+    """Async saves overlap the loop (save() returns before commit) and the
+    final wait leaves a restorable, value-correct checkpoint."""
+    import jax.numpy as jnp
+    import optax
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.state import create_train_state
+
+    model = get_model("lenet5")
+    state = create_train_state(
+        model, optax.sgd(0.1), np.zeros((1, 32, 32, 1), np.float32)
+    )
+    mgr = CheckpointManager(tmp_path / "ck", async_save=True)
+    for e in range(3):
+        state = state.replace(step=state.step + 1)
+        mgr.save(e, state, best_metric=float(e))
+    mgr.wait_until_finished()
+    assert mgr.saved_epochs() == [0, 1, 2]
+    fresh = create_train_state(
+        model, optax.sgd(0.1), np.zeros((1, 32, 32, 1), np.float32)
+    )
+    restored, meta = mgr.restore(fresh)
+    assert int(restored.step) == 3 and meta["epoch"] == 2
+    mgr.close()
+
+
+def test_keep_best_retention(tmp_path):
+    """best-k retention: max_to_keep highest-metric checkpoints survive,
+    recency does not (the reference's save-on-new-best analog,
+    ref: YOLO/tensorflow/train.py:243-257)."""
+    import optax
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.state import create_train_state
+
+    model = get_model("lenet5")
+    state = create_train_state(
+        model, optax.sgd(0.1), np.zeros((1, 32, 32, 1), np.float32)
+    )
+    mgr = CheckpointManager(tmp_path / "ck", max_to_keep=2,
+                            keep_best_of="val_top1")
+    for e, metric in enumerate([0.5, 0.9, 0.7, 0.6]):
+        mgr.save(e, state, metrics={"val_top1": metric})
+    # best two are epochs 1 (0.9) and 2 (0.7)
+    assert mgr.saved_epochs() == [1, 2]
+    mgr.close()
