@@ -11,7 +11,9 @@
 from deepvision_tpu.convert.diff import diff_activations, resnet_name_map
 from deepvision_tpu.convert.keras_import import keras_h5_to_flax
 from deepvision_tpu.convert.torch_import import (
+    inception_torch_to_flax,
     load_torch_checkpoint,
+    mobilenet_torch_to_flax,
     resnet_torch_to_flax,
     strip_module_prefix,
     torch_to_flax,
@@ -21,7 +23,9 @@ __all__ = [
     "diff_activations",
     "resnet_name_map",
     "keras_h5_to_flax",
+    "inception_torch_to_flax",
     "load_torch_checkpoint",
+    "mobilenet_torch_to_flax",
     "resnet_torch_to_flax",
     "strip_module_prefix",
     "torch_to_flax",

@@ -1,0 +1,146 @@
+"""Converter CLI: reference checkpoint file → Orbax checkpoint.
+
+    python -m deepvision_tpu.convert <ckpt.pt|ckpt.h5> -m <model> -o <workdir>
+
+Reads a reference PyTorch ``.pt`` (dict-of-everything or state dict,
+DataParallel prefixes handled — ref: ResNet/pytorch/train.py:417-428) or a
+Keras ``.h5`` and writes ``<workdir>/<model>/ckpt`` in the framework's own
+Orbax layout, directly consumable by ``evaluate.py``/``predict.py``
+(``--workdir <workdir> -m <model>``).
+
+Family dispatch by model name:
+  resnet34/resnet50/resnet152   torch stage/block naming
+  vgg16/vgg19, alexnet2, lenet5 Sequential layer order (+ NCHW flatten fix)
+  inception1_ref                BN-free parity variant incl. aux heads
+  mobilenet1                    dw/pw separable-conv naming
+  resnet50v2                    keras-applications HDF5 naming
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+# (layer list, flatten grid at the conv→linear boundary) per Sequential net
+_SEQUENTIAL = {
+    "vgg16": ("VGG16_LAYERS", (7, 7)),
+    "vgg19": ("VGG19_LAYERS", (7, 7)),
+    "alexnet2": ("ALEXNET2_LAYERS", (6, 6)),
+}
+
+
+def convert_file(path: str, model_name: str, num_classes: int = 1000):
+    """-> Flax variables dict for ``model_name``."""
+    from deepvision_tpu.convert import torch_import as ti
+
+    if path.endswith((".h5", ".hdf5")):
+        if model_name != "resnet50v2":
+            raise SystemExit(
+                f"h5 conversion is wired for resnet50v2, not {model_name}"
+            )
+        from deepvision_tpu.convert.keras_import import keras_h5_to_flax
+
+        return keras_h5_to_flax(path)
+
+    sd = ti.load_torch_checkpoint(path)
+    if model_name in ("resnet34", "resnet50", "resnet152"):
+        return ti.resnet_torch_to_flax(sd)
+    if model_name == "inception1":
+        raise SystemExit(
+            "reference Inception V1 weights are BN-free — convert with "
+            "-m inception1_ref (the reference-exact model variant)"
+        )
+    if model_name == "inception1_ref":
+        return ti.inception_torch_to_flax(sd)
+    if model_name == "mobilenet1":
+        return ti.mobilenet_torch_to_flax(sd)
+    if model_name in _SEQUENTIAL:
+        layers_name, grid = _SEQUENTIAL[model_name]
+        return ti.sequential_torch_to_flax(
+            sd, getattr(ti, layers_name), flatten_grid=grid
+        )
+    raise SystemExit(f"no converter family map for model {model_name!r}")
+
+
+def save_as_checkpoint(variables: dict, model_name: str, workdir: str,
+                       num_classes: int, input_size: int, channels: int):
+    """Wrap converted variables in a TrainState and write epoch 0 through
+    the framework's CheckpointManager (restore via restore_inference)."""
+    import optax
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.state import create_train_state
+
+    model = get_model(model_name, num_classes=num_classes)
+    sample = np.zeros((1, input_size, input_size, channels), np.float32)
+    state = create_train_state(model, optax.sgd(0.1), sample)
+
+    def check_tree(template, got, coll):
+        t_paths = {p for p, _ in _leaves(template)}
+        g_paths = {p for p, _ in _leaves(got)}
+        if t_paths != g_paths:
+            missing = sorted(t_paths - g_paths)[:8]
+            extra = sorted(g_paths - t_paths)[:8]
+            raise SystemExit(
+                f"{coll} tree mismatch for {model_name}: "
+                f"missing={missing} extra={extra}"
+            )
+
+    check_tree(state.params, variables["params"], "params")
+    if state.batch_stats:
+        check_tree(state.batch_stats, variables.get("batch_stats", {}),
+                   "batch_stats")
+    state = state.replace(
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", state.batch_stats) or
+        state.batch_stats,
+    )
+    out = Path(workdir) / model_name / "ckpt"
+    mgr = CheckpointManager(out)
+    mgr.save(0, state, extra={"converted_from": "reference-checkpoint"})
+    mgr.close()
+    return out
+
+
+def _leaves(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _leaves(v, prefix + (k,))
+    else:
+        yield "/".join(prefix), tree
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m deepvision_tpu.convert", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("checkpoint", help=".pt/.h5 reference checkpoint file")
+    p.add_argument("-m", "--model", required=True)
+    p.add_argument("-o", "--workdir", required=True,
+                   help="output workdir (evaluate.py --workdir)")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--input-size", type=int, default=224)
+    p.add_argument("--channels", type=int, default=3)
+    args = p.parse_args(argv)
+
+    variables = convert_file(args.checkpoint, args.model, args.num_classes)
+    out = save_as_checkpoint(
+        variables, args.model, args.workdir,
+        args.num_classes, args.input_size, args.channels,
+    )
+    n_params = sum(
+        int(np.prod(np.shape(v))) for _, v in _leaves(variables["params"])
+    )
+    print(f"converted {args.checkpoint} -> {out} "
+          f"({n_params:,} params, model={args.model})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -232,6 +232,119 @@ VGG16_LAYERS = [
     "conv5_1", "conv5_2", "conv5_3",
     "fc1", "fc2", "fc3",
 ]
+VGG19_LAYERS = [
+    "conv1_1", "conv1_2", "conv2_1", "conv2_2",
+    "conv3_1", "conv3_2", "conv3_3", "conv3_4",
+    "conv4_1", "conv4_2", "conv4_3", "conv4_4",
+    "conv5_1", "conv5_2", "conv5_3", "conv5_4",
+    "fc1", "fc2", "fc3",
+]
 ALEXNET2_LAYERS = [
     "conv1", "conv2", "conv3", "conv4", "conv5", "fc6", "fc7", "fc8",
 ]
+
+
+def _mobilenet_key(key: str):
+    """Reference MobileNet V1 torch keys → models.mobilenet paths
+    (ref: MobileNet/pytorch/models/mobilenet_v1.py:27-87: ``features.0``
+    stem conv, ``features.1`` stem BN, ``features.{3..15}`` 13
+    DepthwiseSeparableConvs with dw/pw conv+bn children, ``linear`` head).
+
+    Depthwise kernels: torch (C, 1, KH, KW) with ``groups=C`` →
+    Flax ``feature_group_count`` layout (KH, KW, 1, C) — the same
+    (2, 3, 1, 0) transpose as dense convs.
+    """
+    conv_t = lambda v: v.transpose(2, 3, 1, 0)
+    if key == "features.0.weight":
+        return "params", ("stem", "conv", "kernel"), conv_t
+    m = re.fullmatch(r"features\.1\.(\w+)", key)
+    if m and m.group(1) in _BN_FIELDS:
+        coll, leaf, f = _BN_FIELDS[m.group(1)]
+        return coll, ("stem", "bn", leaf), f
+    m = re.fullmatch(r"features\.(\d+)\.(dw|pw)\.conv\.weight", key)
+    if m:
+        idx, branch = m.groups()
+        return ("params",
+                (f"ds{int(idx) - 2}", branch, "conv", "kernel"), conv_t)
+    m = re.fullmatch(r"features\.(\d+)\.(dw|pw)\.bn\.(\w+)", key)
+    if m:
+        idx, branch, field = m.groups()
+        if field in _BN_FIELDS:
+            coll, leaf, f = _BN_FIELDS[field]
+            return coll, (f"ds{int(idx) - 2}", branch, "bn", leaf), f
+        return None  # num_batches_tracked
+    if key == "linear.weight":
+        return "params", ("fc", "kernel"), lambda v: v.T
+    if key == "linear.bias":
+        return "params", ("fc", "bias"), lambda v: v
+    return None
+
+
+def mobilenet_torch_to_flax(state_dict: Mapping) -> dict:
+    """Reference MobileNet V1 torch weights → Flax variables."""
+    return torch_to_flax(state_dict, _mobilenet_key)
+
+
+_INCEPTION_STEM = {"conv7x7": "stem1", "conv1x1": "stem2", "conv3x3": "stem3"}
+_INCEPTION_BRANCH = {
+    "branch1_conv1x1": "b1",
+    "branch2_conv1x1": "b3r", "branch2_conv3x3": "b3",
+    "branch3_conv1x1": "b5r", "branch3_conv5x5": "b5",
+    "branch4_conv1x1": "bp",
+}
+
+
+def _aux_fc1_weight(v):
+    """The reference flattens the aux 4×4×128 activation NCHW (C-major,
+    ref: inception_v1.py:185-189) while the Flax model flattens NHWC —
+    permute the input dimension C,H,W → H,W,C before transposing."""
+    out = v.shape[0]
+    return (v.reshape(out, 128, 4, 4).transpose(0, 2, 3, 1)
+            .reshape(out, -1).T)
+
+
+def _inception_key(key: str):
+    """Reference Inception V1 torch keys → models.inception paths for the
+    ``bn=False`` parity variant (conv+bias blocks — the reference's
+    BasicConv2d has NO BatchNorm, ref: inception_v1.py:193-200; aux heads
+    ref: inception_v1.py:161-190)."""
+    conv_t = lambda v: v.transpose(2, 3, 1, 0)
+    m = re.fullmatch(r"(conv7x7|conv1x1|conv3x3)\.conv\.(weight|bias)", key)
+    if m:
+        name, field = m.groups()
+        leaf = ("kernel", conv_t) if field == "weight" else ("bias", lambda v: v)
+        return "params", (_INCEPTION_STEM[name], "conv", leaf[0]), leaf[1]
+    m = re.fullmatch(
+        r"inception_(\d[a-e])\.(branch\d_conv\dx\d)\.conv\.(weight|bias)", key
+    )
+    if m:
+        mod, branch, field = m.groups()
+        leaf = ("kernel", conv_t) if field == "weight" else ("bias", lambda v: v)
+        return ("params",
+                (f"i{mod}", _INCEPTION_BRANCH[branch], "conv", leaf[0]),
+                leaf[1])
+    m = re.fullmatch(r"aux([12])\.features\.1\.conv\.(weight|bias)", key)
+    if m:
+        idx, field = m.groups()
+        leaf = ("kernel", conv_t) if field == "weight" else ("bias", lambda v: v)
+        return "params", (f"aux{idx}", "proj", "conv", leaf[0]), leaf[1]
+    m = re.fullmatch(r"aux([12])\.classifier\.([03])\.(weight|bias)", key)
+    if m:
+        idx, layer, field = m.groups()
+        name = "fc1" if layer == "0" else "fc2"
+        if field == "bias":
+            return "params", (f"aux{idx}", name, "bias"), lambda v: v
+        if name == "fc1":
+            return "params", (f"aux{idx}", "fc1", "kernel"), _aux_fc1_weight
+        return "params", (f"aux{idx}", "fc2", "kernel"), lambda v: v.T
+    if key == "linear.weight":
+        return "params", ("fc", "kernel"), lambda v: v.T
+    if key == "linear.bias":
+        return "params", ("fc", "bias"), lambda v: v
+    return None
+
+
+def inception_torch_to_flax(state_dict: Mapping) -> dict:
+    """Reference Inception V1 torch weights (incl. aux heads) → Flax
+    variables for ``InceptionV1(bn=False)``."""
+    return torch_to_flax(state_dict, _inception_key)

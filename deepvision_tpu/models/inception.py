@@ -23,10 +23,36 @@ import jax.numpy as jnp
 from deepvision_tpu.models import layers
 from deepvision_tpu.models.layers import ConvBN
 from deepvision_tpu.models.registry import register
+from deepvision_tpu.ops.lrn import local_response_norm
+
+
+class BasicConv(nn.Module):
+    """conv(+bias)+ReLU — the reference's ``BasicConv2d`` exactly (NO
+    BatchNorm, ref: Inception/pytorch/models/inception_v1.py:193-200).
+    Converter-parity twin of ConvBN; child named ``conv`` so torch keys
+    map onto the same path shape."""
+
+    features: int
+    kernel: tuple[int, int] = (1, 1)
+    strides: tuple[int, int] = (1, 1)
+    padding: str | tuple = "SAME"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=True, dtype=self.dtype,
+                    name="conv")(x)
+        return nn.relu(x)
 
 
 class InceptionModule(nn.Module):
-    """4-branch module: 1x1 | 1x1→3x3 | 1x1→5x5 | pool→1x1."""
+    """4-branch module: 1x1 | 1x1→3x3 | 1x1→5x5 | pool→1x1.
+
+    ``bn=True`` (default) is the BN-modernized variant this framework
+    trains; ``bn=False`` reproduces the reference's conv+bias+ReLU blocks
+    for checkpoint-converter logits parity."""
 
     c1: int
     c3r: int
@@ -35,17 +61,19 @@ class InceptionModule(nn.Module):
     c5: int
     cp: int
     dtype: jnp.dtype = jnp.float32
+    bn: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         d = self.dtype
-        b1 = ConvBN(self.c1, (1, 1), dtype=d, name="b1")(x, train)
-        b3 = ConvBN(self.c3r, (1, 1), dtype=d, name="b3r")(x, train)
-        b3 = ConvBN(self.c3, (3, 3), dtype=d, name="b3")(b3, train)
-        b5 = ConvBN(self.c5r, (1, 1), dtype=d, name="b5r")(x, train)
-        b5 = ConvBN(self.c5, (5, 5), dtype=d, name="b5")(b5, train)
+        conv = ConvBN if self.bn else BasicConv
+        b1 = conv(self.c1, (1, 1), dtype=d, name="b1")(x, train)
+        b3 = conv(self.c3r, (1, 1), dtype=d, name="b3r")(x, train)
+        b3 = conv(self.c3, (3, 3), dtype=d, name="b3")(b3, train)
+        b5 = conv(self.c5r, (1, 1), dtype=d, name="b5r")(x, train)
+        b5 = conv(self.c5, (5, 5), dtype=d, name="b5")(b5, train)
         bp = layers.max_pool(x, (3, 3), (1, 1), padding="SAME")
-        bp = ConvBN(self.cp, (1, 1), dtype=d, name="bp")(bp, train)
+        bp = conv(self.cp, (1, 1), dtype=d, name="bp")(bp, train)
         return jnp.concatenate([b1, b3, b5, bp], axis=-1)
 
 
@@ -55,11 +83,13 @@ class AuxiliaryClassifier(nn.Module):
 
     num_classes: int
     dtype: jnp.dtype = jnp.float32
+    bn: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        conv = ConvBN if self.bn else BasicConv
         x = layers.avg_pool(x, (5, 5), (3, 3))
-        x = ConvBN(128, (1, 1), dtype=self.dtype, name="proj")(x, train)
+        x = conv(128, (1, 1), dtype=self.dtype, name="proj")(x, train)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(1024, dtype=self.dtype, name="fc1")(x))
         x = nn.Dropout(0.7, deterministic=not train)(x)
@@ -67,39 +97,56 @@ class AuxiliaryClassifier(nn.Module):
 
 
 class InceptionV1(nn.Module):
+    """``bn=True`` (default): the BN-modernized training variant.
+    ``bn=False``: the reference's exact architecture — conv+bias+ReLU
+    blocks, stem LRNs after pool1/conv3x3, torch-symmetric stem padding —
+    for converter logits parity
+    (ref: Inception/pytorch/models/inception_v1.py:27-113)."""
+
     num_classes: int = 1000
     aux_heads: bool = True
     dtype: jnp.dtype = jnp.float32
+    bn: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         d = self.dtype
+        conv = ConvBN if self.bn else BasicConv
         x = x.astype(d)
-        x = ConvBN(64, (7, 7), (2, 2), dtype=d, name="stem1")(x, train)
+        # torch pads the 7x7/2 stem (3,3); XLA "SAME" would pad (2,3)
+        x = conv(64, (7, 7), (2, 2),
+                 padding="SAME" if self.bn else ((3, 3), (3, 3)),
+                 dtype=d, name="stem1")(x, train)
         x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
-        x = ConvBN(64, (1, 1), dtype=d, name="stem2")(x, train)
-        x = ConvBN(192, (3, 3), dtype=d, name="stem3")(x, train)
+        if not self.bn:  # ref: inception_v1.py:30,82 — torch LRN defaults
+            x = local_response_norm(x, size=64, alpha=1e-4, beta=0.75, k=1.0)
+        x = conv(64, (1, 1), dtype=d, name="stem2")(x, train)
+        x = conv(192, (3, 3), dtype=d, name="stem3")(x, train)
+        if not self.bn:  # ref: inception_v1.py:38,85
+            x = local_response_norm(x, size=64, alpha=1e-4, beta=0.75, k=1.0)
         x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
 
-        x = InceptionModule(64, 96, 128, 16, 32, 32, dtype=d, name="i3a")(x, train)
-        x = InceptionModule(128, 128, 192, 32, 96, 64, dtype=d, name="i3b")(x, train)
+        mod = lambda *c, name: InceptionModule(*c, dtype=d, bn=self.bn,
+                                               name=name)
+        x = mod(64, 96, 128, 16, 32, 32, name="i3a")(x, train)
+        x = mod(128, 128, 192, 32, 96, 64, name="i3b")(x, train)
         x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
-        x = InceptionModule(192, 96, 208, 16, 48, 64, dtype=d, name="i4a")(x, train)
+        x = mod(192, 96, 208, 16, 48, 64, name="i4a")(x, train)
         aux1 = None
         if self.aux_heads and train:
             aux1 = AuxiliaryClassifier(self.num_classes, dtype=d,
-                                       name="aux1")(x, train)
-        x = InceptionModule(160, 112, 224, 24, 64, 64, dtype=d, name="i4b")(x, train)
-        x = InceptionModule(128, 128, 256, 24, 64, 64, dtype=d, name="i4c")(x, train)
-        x = InceptionModule(112, 144, 288, 32, 64, 64, dtype=d, name="i4d")(x, train)
+                                       bn=self.bn, name="aux1")(x, train)
+        x = mod(160, 112, 224, 24, 64, 64, name="i4b")(x, train)
+        x = mod(128, 128, 256, 24, 64, 64, name="i4c")(x, train)
+        x = mod(112, 144, 288, 32, 64, 64, name="i4d")(x, train)
         aux2 = None
         if self.aux_heads and train:
             aux2 = AuxiliaryClassifier(self.num_classes, dtype=d,
-                                       name="aux2")(x, train)
-        x = InceptionModule(256, 160, 320, 32, 128, 128, dtype=d, name="i4e")(x, train)
+                                       bn=self.bn, name="aux2")(x, train)
+        x = mod(256, 160, 320, 32, 128, 128, name="i4e")(x, train)
         x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
-        x = InceptionModule(256, 160, 320, 32, 128, 128, dtype=d, name="i5a")(x, train)
-        x = InceptionModule(384, 192, 384, 48, 128, 128, dtype=d, name="i5b")(x, train)
+        x = mod(256, 160, 320, 32, 128, 128, name="i5a")(x, train)
+        x = mod(384, 192, 384, 48, 128, 128, name="i5b")(x, train)
 
         x = layers.global_avg_pool(x)
         x = nn.Dropout(0.4, deterministic=not train)(x)
@@ -259,6 +306,14 @@ class InceptionV3(nn.Module):
 
 @register("inception1")
 def _inception_v1(**kw):
+    return InceptionV1(**kw)
+
+
+@register("inception1_ref")
+def _inception_v1_ref(**kw):
+    """Reference-exact (BN-free) variant — the checkpoint-converter
+    target (convert/torch_import.inception_torch_to_flax)."""
+    kw.setdefault("bn", False)
     return InceptionV1(**kw)
 
 

@@ -44,7 +44,11 @@ class DepthwiseSeparableConv(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         in_ch = x.shape[-1]
+        # explicit symmetric padding: the reference's torch convs pad (1,1)
+        # while XLA "SAME" pads (0,1) under stride 2 — activation parity
+        # for the checkpoint converter (same convention as models/resnet.py)
         x = ConvBN(in_ch, (3, 3), (self.strides,) * 2, groups=in_ch,
+                   padding=((1, 1), (1, 1)),
                    dtype=self.dtype, name="dw")(x, train)
         x = ConvBN(self.features, (1, 1), dtype=self.dtype, name="pw")(x, train)
         return x
@@ -59,7 +63,9 @@ class MobileNetV1(nn.Module):
     def __call__(self, x, train: bool = False):
         d, a = self.dtype, self.alpha
         x = x.astype(d)
-        x = ConvBN(_scale(32, a), (3, 3), (2, 2), dtype=d, name="stem")(x, train)
+        x = ConvBN(_scale(32, a), (3, 3), (2, 2),
+                   padding=((1, 1), (1, 1)),  # torch pad parity (ref :31)
+                   dtype=d, name="stem")(x, train)
         cfg = [  # (features, stride) per paper Table 1
             (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
             (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),

@@ -396,3 +396,241 @@ def test_sequential_converter_alexnet2_logits_match():
             torch.from_numpy(img.transpose(0, 3, 1, 2))
         ).numpy()
     np.testing.assert_allclose(flax_logits, torch_logits, atol=1e-3)
+
+
+# --------------------------------------------- mobilenet / inception maps
+
+
+class _TorchDWSep(tnn.Module):
+    """dw(conv/bn/relu) + pw(conv/bn/relu), reference child naming
+    (ref: MobileNet/pytorch/models/mobilenet_v1.py:95-156)."""
+
+    class _Branch(tnn.Module):
+        def __init__(self, conv, ch):
+            super().__init__()
+            self.conv = conv
+            self.bn = tnn.BatchNorm2d(ch)
+            self.relu = tnn.ReLU()
+
+        def forward(self, x):
+            return self.relu(self.bn(self.conv(x)))
+
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = self._Branch(
+            tnn.Conv2d(cin, cin, 3, stride, 1, groups=cin, bias=False), cin
+        )
+        self.pw = self._Branch(tnn.Conv2d(cin, cout, 1, bias=False), cout)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class _TorchMobileNetV1(tnn.Module):
+    """State-dict-key twin of the reference net (features.0/1 stem,
+    features.3..15 separable convs, linear head —
+    ref: mobilenet_v1.py:27-87)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2), (512, 512, 1), (512, 512, 1),
+               (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 1024, 2),
+               (1024, 1024, 1)]
+        self.features = tnn.Sequential(
+            tnn.Conv2d(3, 32, 3, 2, 1, bias=False),
+            tnn.BatchNorm2d(32),
+            tnn.ReLU(),
+            *[_TorchDWSep(ci, co, s) for ci, co, s in cfg],
+            tnn.AdaptiveAvgPool2d((1, 1)),
+        )
+        self.linear = tnn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.linear(x.flatten(1))
+
+
+def test_mobilenet_converter_logits_match():
+    from deepvision_tpu.convert import mobilenet_torch_to_flax
+
+    torch.manual_seed(5)
+    tm = _TorchMobileNetV1(num_classes=10).eval()
+    variables = mobilenet_torch_to_flax(tm.state_dict())
+    model = get_model("mobilenet1", num_classes=10)
+    img = np.random.default_rng(4).normal(
+        size=(1, 224, 224, 3)
+    ).astype(np.float32)
+    got = np.asarray(model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables["batch_stats"]},
+        img, train=False,
+    ))
+    with torch.no_grad():
+        want = tm(torch.from_numpy(img.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+class _TorchBasicConv2d(tnn.Module):
+    """conv+bias+relu (ref: inception_v1.py:193-200)."""
+
+    def __init__(self, cin, cout, k, **kw):
+        super().__init__()
+        self.conv = tnn.Conv2d(cin, cout, k, **kw)
+
+    def forward(self, x):
+        return torch.relu(self.conv(x))
+
+
+class _TorchInceptionModule(tnn.Module):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, cp):
+        super().__init__()
+        self.branch1_conv1x1 = _TorchBasicConv2d(cin, c1, 1)
+        self.branch2_conv1x1 = _TorchBasicConv2d(cin, c3r, 1)
+        self.branch2_conv3x3 = _TorchBasicConv2d(c3r, c3, 3, padding=1)
+        self.branch3_conv1x1 = _TorchBasicConv2d(cin, c5r, 1)
+        self.branch3_conv5x5 = _TorchBasicConv2d(c5r, c5, 5, padding=2)
+        self.branch4_maxpool = tnn.MaxPool2d(3, 1, padding=1)
+        self.branch4_conv1x1 = _TorchBasicConv2d(cin, cp, 1)
+
+    def forward(self, x):
+        return torch.cat([
+            self.branch1_conv1x1(x),
+            self.branch2_conv3x3(self.branch2_conv1x1(x)),
+            self.branch3_conv5x5(self.branch3_conv1x1(x)),
+            self.branch4_conv1x1(self.branch4_maxpool(x)),
+        ], dim=1)
+
+
+class _TorchAux(tnn.Module):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.features = tnn.Sequential(
+            tnn.AvgPool2d(5, 3), _TorchBasicConv2d(cin, 128, 1)
+        )
+        self.classifier = tnn.Sequential(
+            tnn.Linear(4 * 4 * 128, 1024), tnn.ReLU(),
+            tnn.Dropout(0.7), tnn.Linear(1024, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.classifier(x.view(x.size(0), 4 * 4 * 128))
+
+
+class _TorchInceptionV1(tnn.Module):
+    """Key-naming twin of the reference incl. aux heads and stem LRNs
+    (ref: inception_v1.py:27-113)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv7x7 = _TorchBasicConv2d(3, 64, 7, stride=2, padding=3)
+        self.maxpool1 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.lrn1 = tnn.LocalResponseNorm(64)
+        self.conv1x1 = _TorchBasicConv2d(64, 64, 1)
+        self.conv3x3 = _TorchBasicConv2d(64, 192, 3, padding=1)
+        self.lrn2 = tnn.LocalResponseNorm(64)
+        self.maxpool2 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.inception_3a = _TorchInceptionModule(192, 64, 96, 128, 16, 32, 32)
+        self.inception_3b = _TorchInceptionModule(256, 128, 128, 192, 32, 96, 64)
+        self.maxpool3 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.inception_4a = _TorchInceptionModule(480, 192, 96, 208, 16, 48, 64)
+        self.aux1 = _TorchAux(512, num_classes)
+        self.inception_4b = _TorchInceptionModule(512, 160, 112, 224, 24, 64, 64)
+        self.inception_4c = _TorchInceptionModule(512, 128, 128, 256, 24, 64, 64)
+        self.inception_4d = _TorchInceptionModule(512, 112, 144, 288, 32, 64, 64)
+        self.aux2 = _TorchAux(528, num_classes)
+        self.inception_4e = _TorchInceptionModule(528, 256, 160, 320, 32, 128, 128)
+        self.maxpool4 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.inception_5a = _TorchInceptionModule(832, 256, 160, 320, 32, 128, 128)
+        self.inception_5b = _TorchInceptionModule(832, 384, 192, 384, 48, 128, 128)
+        self.avgpool = tnn.AdaptiveAvgPool2d((1, 1))
+        self.linear = tnn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.lrn1(self.maxpool1(self.conv7x7(x)))
+        x = self.maxpool2(self.lrn2(self.conv3x3(self.conv1x1(x))))
+        x = self.inception_3b(self.inception_3a(x))
+        x = self.maxpool3(x)
+        x = self.inception_4a(x)
+        x = self.inception_4d(self.inception_4c(self.inception_4b(x)))
+        x = self.inception_4e(x)
+        x = self.maxpool4(x)
+        x = self.inception_5b(self.inception_5a(x))
+        x = self.avgpool(x)
+        return self.linear(x.flatten(1))
+
+
+@pytest.fixture(scope="module")
+def inception_pair():
+    from deepvision_tpu.convert import inception_torch_to_flax
+
+    torch.manual_seed(7)
+    tm = _TorchInceptionV1(num_classes=10).eval()
+    variables = inception_torch_to_flax(tm.state_dict())
+    return tm, variables
+
+
+def test_inception_converter_main_logits_match(inception_pair):
+    tm, variables = inception_pair
+    model = get_model("inception1_ref", num_classes=10)
+    img = np.random.default_rng(6).normal(
+        size=(1, 224, 224, 3)
+    ).astype(np.float32)
+    got = np.asarray(model.apply(
+        {"params": variables["params"]}, img, train=False
+    ))
+    with torch.no_grad():
+        want = tm(torch.from_numpy(img.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+def test_inception_converter_aux_head_logits_match(inception_pair):
+    """Aux-head weights (incl. the NCHW→NHWC flatten permute of fc1) map
+    correctly: drive the aux submodule alone in eval mode."""
+    from deepvision_tpu.models.inception import AuxiliaryClassifier
+
+    tm, variables = inception_pair
+    act = np.random.default_rng(8).normal(
+        size=(1, 14, 14, 512)
+    ).astype(np.float32)
+    aux = AuxiliaryClassifier(10, bn=False)
+    got = np.asarray(aux.apply(
+        {"params": variables["params"]["aux1"]}, act, train=False
+    ))
+    with torch.no_grad():
+        want = tm.aux1(
+            torch.from_numpy(np.ascontiguousarray(act.transpose(0, 3, 1, 2)))
+        ).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+def test_converter_cli_end_to_end(tmp_path):
+    """python -m deepvision_tpu.convert <pt> -m mobilenet1 -o <dir> writes
+    a checkpoint predict.load_state / evaluate.py consume directly."""
+    from deepvision_tpu.convert.__main__ import main as convert_main
+
+    torch.manual_seed(9)
+    tm = _TorchMobileNetV1(num_classes=10).eval()
+    pt = tmp_path / "mobilenet.pt"
+    torch.save({"epoch": 3, "model": tm.state_dict()}, pt)
+
+    rc = convert_main([
+        str(pt), "-m", "mobilenet1", "-o", str(tmp_path / "out"),
+        "--num-classes", "10",
+    ])
+    assert rc == 0
+
+    import predict
+
+    img = np.random.default_rng(10).normal(
+        size=(1, 224, 224, 3)
+    ).astype(np.float32)
+    state = predict.load_state(
+        "mobilenet1", str(tmp_path / "out" / "mobilenet1"), img,
+        num_classes=10,
+    )
+    got = np.asarray(predict._apply(state, img))
+    with torch.no_grad():
+        want = tm(torch.from_numpy(img.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3)
