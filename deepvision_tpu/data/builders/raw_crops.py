@@ -1,0 +1,98 @@
+"""Pre-decoded raw-crop TFRecords: the input-pipeline fast path.
+
+The JPEG pipeline is host-decode-bound (~a few hundred img/s per host
+core — SURVEY §7 hard part #1; the reference never hit this because its
+GPUs were slower than its CPUs, ref: ResNet/tensorflow/data_load.py:35-193
+is the decode path being bypassed). This builder runs the decode +
+aspect-preserving resize ONCE offline, storing fixed-size raw uint8
+crops; the training-time reader is then a parse + reshape — no JPEG
+work — so feeding scales with disk/memory bandwidth instead of CPU.
+
+Records keep augmentation diversity: the stored crop is the ``stored``²
+center region (default 256², the resize floor), and the reader still
+applies the random ``size``² crop + flip per epoch.
+
+Schema: ``image/raw`` (stored·stored·3 uint8 bytes),
+``image/class/label`` (int, [1,1000] like the reference builder's),
+``image/height``/``image/width`` (= stored, for validation).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def _tf():
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    return tf
+
+
+def jpeg_record_to_raw(serialized: bytes, stored: int) -> dict | None:
+    """One reference-schema JPEG Example -> raw-crop feature dict."""
+    tf = _tf()
+    feats = tf.io.parse_single_example(
+        serialized,
+        {
+            "image/encoded": tf.io.FixedLenFeature([], tf.string),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+        },
+    )
+    image = tf.io.decode_jpeg(feats["image/encoded"], channels=3)
+    shape = tf.shape(image)
+    h, w = tf.cast(shape[0], tf.float32), tf.cast(shape[1], tf.float32)
+    scale = stored / tf.minimum(h, w)
+    new_h = tf.cast(tf.math.ceil(h * scale), tf.int32)
+    new_w = tf.cast(tf.math.ceil(w * scale), tf.int32)
+    image = tf.image.resize(tf.cast(image, tf.float32), [new_h, new_w])
+    off_h = (new_h - stored) // 2
+    off_w = (new_w - stored) // 2
+    image = tf.slice(image, [off_h, off_w, 0], [stored, stored, 3])
+    raw = tf.cast(tf.clip_by_value(tf.round(image), 0, 255), tf.uint8)
+    return {
+        "image/raw": [raw.numpy().tobytes()],
+        "image/class/label": [int(feats["image/class/label"].numpy())],
+        "image/height": [stored],
+        "image/width": [stored],
+    }
+
+
+def build_raw_crops(
+    jpeg_dir: str | Path,
+    output_dir: str | Path,
+    *,
+    split: str = "train",
+    stored: int = 256,
+    num_shards: int = 64,
+    num_workers: int = 8,
+) -> int:
+    """Reference-schema JPEG TFRecords (``<split>-*``) → raw-crop shards
+    (``raw-<split>-*``). Returns the record count."""
+    from functools import partial
+
+    from deepvision_tpu.data.builders.shard_writer import write_sharded
+    from deepvision_tpu.data.tfrecord import read_records
+
+    files = sorted(Path(jpeg_dir).glob(f"{split}-*"))
+    if not files:
+        raise FileNotFoundError(f"no {split}-* records under {jpeg_dir}")
+    items = [rec for f in files for rec in read_records(f)]
+    write_sharded(
+        items,
+        partial(jpeg_record_to_raw, stored=stored),  # picklable for mp
+        output_dir,
+        f"raw-{split}",
+        num_shards=num_shards,
+        num_workers=num_workers,
+    )
+    # sidecar: readers gate the fast path on the stored crop size
+    # (named with '.' so the 'raw-<split>-*' shard glob can't match it)
+    import json
+
+    (Path(output_dir) / f"raw-{split}.meta.json").write_text(
+        json.dumps({"stored": stored, "count": len(items)})
+    )
+    return len(items)

@@ -143,6 +143,96 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     return image, label
 
 
+def parse_raw_crop(serialized, size: int, stored: int, is_training: bool,
+                   augment: str = "tf"):
+    """One pre-decoded raw-crop Example (data/builders/raw_crops.py) ->
+    (uint8 image [size,size,3], int32 label). No JPEG decode: parse +
+    reshape + random crop/flip only — the fast path when the host CPU,
+    not the record format, bounds feeding. ColorJitter (augment="pt")
+    still applies; normalization always runs on device (uint8 wire)."""
+    tf = _tf()
+    feats = tf.io.parse_single_example(
+        serialized,
+        {
+            "image/raw": tf.io.FixedLenFeature([], tf.string),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+        },
+    )
+    image = tf.reshape(
+        tf.io.decode_raw(feats["image/raw"], tf.uint8), [stored, stored, 3]
+    )
+    if is_training:
+        image = tf.image.random_crop(image, [size, size, 3])
+        image = tf.image.random_flip_left_right(image)
+        if augment == "pt":
+            jittered = _random_jitter(tf.cast(image, tf.float32), PT_JITTER)
+            image = tf.cast(jittered, tf.uint8)
+    else:
+        off = (stored - size) // 2
+        image = tf.slice(image, [off, off, 0], [size, size, 3])
+    label = tf.cast(feats["image/class/label"], tf.int32) - 1
+    return image, label
+
+
+def _records_pipeline(
+    file_pattern: str,
+    batch_size: int,
+    parse_fn,
+    *,
+    is_training: bool,
+    shuffle_buffer: int,
+    num_process: int,
+    process_index: int,
+    seed: int,
+):
+    """Shared scaffolding for the JPEG and raw-crop readers: per-process
+    file sharding (the ``experimental_distribute_dataset`` analog —
+    ref: YOLO/tensorflow/train.py:291-294) and the epoch-seeded shuffle
+    (resume at epoch N reproduces the order an uninterrupted run would
+    have seen — SURVEY §5.3, the deterministic data-order restore the
+    reference lacks)."""
+    tf = _tf()
+    files = tf.data.Dataset.list_files(file_pattern, shuffle=is_training,
+                                       seed=seed)
+    if num_process > 1:
+        files = files.shard(num_process, process_index)
+    ds = tf.data.TFRecordDataset(files, num_parallel_reads=tf.data.AUTOTUNE)
+    if is_training:
+        ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
+    ds = ds.map(parse_fn, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(batch_size, drop_remainder=is_training)
+    return ds.prefetch(tf.data.AUTOTUNE)
+
+
+def make_raw_dataset(
+    file_pattern: str,
+    batch_size: int,
+    size: int = 224,
+    *,
+    is_training: bool,
+    stored: int = 256,
+    shuffle_buffer: int = 10_000,
+    num_process: int = 1,
+    process_index: int = 0,
+    augment: str = "tf",
+    seed: int = 0,
+):
+    """tf.data pipeline over raw-crop shards (``raw-<split>-*``); same
+    sharding/epoch-seeding contract as :func:`make_dataset`. ``size``
+    must be < ``stored`` (the reader's only augmentation freedom is the
+    random crop inside the stored region)."""
+    if size >= stored:
+        raise ValueError(
+            f"raw-crop reader needs size < stored, got {size} >= {stored}"
+        )
+    return _records_pipeline(
+        file_pattern, batch_size,
+        lambda s: parse_raw_crop(s, size, stored, is_training, augment),
+        is_training=is_training, shuffle_buffer=shuffle_buffer,
+        num_process=num_process, process_index=process_index, seed=seed,
+    )
+
+
 def make_dataset(
     file_pattern: str,
     batch_size: int,
@@ -156,30 +246,14 @@ def make_dataset(
     augment: str = "tf",
     seed: int = 0,
 ):
-    """tf.data pipeline over sharded TFRecords; per-host file sharding for
-    multi-host (the ``experimental_distribute_dataset`` analog —
-    ref: YOLO/tensorflow/train.py:291-294)."""
-    tf = _tf()
-    files = tf.data.Dataset.list_files(file_pattern, shuffle=is_training,
-                                       seed=seed)
-    if num_process > 1:
-        files = files.shard(num_process, process_index)
-    ds = tf.data.TFRecordDataset(
-        files, num_parallel_reads=tf.data.AUTOTUNE
-    )
-    if is_training:
-        # epoch-seeded shuffle: resume at epoch N reproduces the order an
-        # uninterrupted run would have seen (SURVEY §5.3 — the
-        # deterministic data-order restore the reference lacks)
-        ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
-    ds = ds.map(
+    """tf.data pipeline over sharded JPEG TFRecords (reference schema)."""
+    return _records_pipeline(
+        file_pattern, batch_size,
         lambda s: parse_and_preprocess(s, size, is_training, as_uint8,
                                        augment),
-        num_parallel_calls=tf.data.AUTOTUNE,
+        is_training=is_training, shuffle_buffer=shuffle_buffer,
+        num_process=num_process, process_index=process_index, seed=seed,
     )
-    ds = ds.batch(batch_size, drop_remainder=is_training)
-    ds = ds.prefetch(tf.data.AUTOTUNE)
-    return ds
 
 
 def _as_batches(ds, limit: int | None = None, pad_to: int | None = None):
@@ -223,15 +297,35 @@ def make_imagenet_data(
         )
     local_bs = batch_size // nproc
 
+    # fast path: pre-decoded raw-crop shards (builders/raw_crops.py)
+    # bypass the JPEG decode bound — taken only when the requested crop
+    # fits inside the stored region (sidecar written by the builder), so
+    # 299²-input models fall back to the JPEG path instead of crashing
+    raw_stored = None
+    meta_path = d / "raw-train.meta.json"
+    if meta_path.exists():
+        import json
+
+        raw_stored = json.loads(meta_path.read_text()).get("stored")
+    have_raw = (raw_stored is not None and size < raw_stored
+                and any(d.glob("raw-train-*")))
+
     def train_data(epoch: int):
         # Multi-host (train_dist.py): each process reads a DISJOINT file
         # shard and batches its local share; core.shard_batch assembles
         # the locals into the global array (local × nproc = global).
-        ds = make_dataset(str(d / "train-*"), local_bs, size,
-                          is_training=True, as_uint8=train_as_uint8,
-                          augment=augment,
-                          num_process=nproc, process_index=pid,
-                          seed=epoch)
+        if have_raw:
+            ds = make_raw_dataset(str(d / "raw-train-*"), local_bs, size,
+                                  is_training=True, stored=raw_stored,
+                                  augment=augment,
+                                  num_process=nproc, process_index=pid,
+                                  seed=epoch)
+        else:
+            ds = make_dataset(str(d / "train-*"), local_bs, size,
+                              is_training=True, as_uint8=train_as_uint8,
+                              augment=augment,
+                              num_process=nproc, process_index=pid,
+                              seed=epoch)
         return _as_batches(ds, steps)
 
     def val_data():

@@ -1,0 +1,81 @@
+"""Subprocess body for the 2-process jax.distributed CPU test.
+
+Each process joins the distributed runtime, reads ITS OWN ImageNet file
+shard (data/imagenet.py ``num_process``/``process_index``), assembles
+global batches via ``core.shard_batch``'s
+``make_array_from_process_local_data`` branch (core/mesh.py), runs two
+compiled train steps over the global mesh, slices its per-process block
+of the shared validation stream, and dumps everything the parent test
+needs to verify equivalence with a single-process run.
+
+Launched by tests/test_distributed.py — not a test module itself.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def main():
+    coordinator, pid, nproc, data_dir, out_dir = sys.argv[1:6]
+    pid, nproc = int(pid), int(nproc)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc
+    assert jax.local_device_count() == 2  # forced via XLA_FLAGS
+    assert jax.device_count() == 2 * nproc
+
+    import optax
+
+    from deepvision_tpu.core import create_mesh, shard_batch
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.data.imagenet import make_imagenet_data
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.state import create_train_state
+    from deepvision_tpu.train.steps import classification_train_step
+
+    global_bs = 8
+    train_data, val_data, steps = make_imagenet_data(
+        data_dir, global_bs, 32, train_images=16, val_images=8,
+    )
+    assert steps == 2
+
+    mesh = create_mesh()  # (4, 1): data axis spans both processes
+    model = get_model("lenet5", num_classes=4)
+    state = create_train_state(
+        model, optax.sgd(0.1, momentum=0.9),
+        np.zeros((1, 32, 32, 3), np.float32),
+    )
+    step = compile_train_step(classification_train_step, mesh)
+
+    out = Path(out_dir)
+    losses = []
+    for i, batch in zip(range(2), train_data(0)):
+        np.savez(out / f"train_p{pid}_s{i}.npz", **batch)
+        db = shard_batch(mesh, batch)  # multi-process assembly branch
+        state, metrics = step(state, db, jax.random.key(100 + i))
+        losses.append(float(metrics["loss"]))
+
+    val_batch = next(iter(val_data()))  # per-process row block
+    np.savez(out / f"val_p{pid}.npz", **val_batch)
+
+    (out / f"result_p{pid}.json").write_text(json.dumps({
+        "losses": losses,
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+    }))
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

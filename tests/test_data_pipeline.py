@@ -231,3 +231,48 @@ def test_pt_augment_pipeline_modes(fake_imagenet, tmp_path):
     assert img.dtype == np.float32
     # torchvision normalization bounds: ((0..1) - mean)/std
     assert img.min() >= -2.2 and img.max() <= 2.8
+
+
+def test_raw_crop_builder_and_reader(fake_imagenet, tmp_path):
+    """JPEG records → raw-crop shards → reader roundtrip: the fast path
+    feeds the same images the JPEG pipeline would (identical center
+    crops), with no decode work at read time."""
+    from deepvision_tpu.data.builders.imagenet import (
+        build_imagenet_tfrecords,
+    )
+    from deepvision_tpu.data.builders.raw_crops import build_raw_crops
+    from deepvision_tpu.data.imagenet import make_dataset, make_raw_dataset
+
+    out = tmp_path / "records"
+    build_imagenet_tfrecords(
+        str(fake_imagenet / "train"), str(fake_imagenet / "synsets.txt"),
+        str(out), split="train", num_shards=2,
+    )
+    n = build_raw_crops(out, out, split="train", stored=256,
+                        num_shards=2, num_workers=2)
+    assert n == 8
+
+    raw_eval = make_raw_dataset(str(out / "raw-train-*"), 8, 224,
+                                is_training=False)
+    imgs, lbls = next(iter(raw_eval.as_numpy_iterator()))
+    assert imgs.shape == (8, 224, 224, 3) and imgs.dtype == np.uint8
+    assert lbls.min() >= 0 and lbls.max() <= 3
+
+    # eval-mode equivalence with the JPEG pipeline: same resize floor +
+    # center crop → identical uint8 pixels, decoupled only by file order
+    jpeg_eval = make_dataset(str(out / "train-*"), 8, 224,
+                             is_training=False, as_uint8=True)
+    jimgs, jlbls = next(iter(jpeg_eval.as_numpy_iterator()))
+
+    def canonical(im, lb):  # order-insensitive: sort by (label, bytes)
+        return sorted(
+            (int(l), im[i].tobytes()) for i, l in enumerate(lb)
+        )
+
+    assert canonical(imgs, lbls) == canonical(jimgs, jlbls)
+
+    # training mode: random crop + flip still applies
+    raw_train = make_raw_dataset(str(out / "raw-train-*"), 4, 224,
+                                 is_training=True, seed=0)
+    timgs, _ = next(iter(raw_train.as_numpy_iterator()))
+    assert timgs.shape == (4, 224, 224, 3) and timgs.dtype == np.uint8

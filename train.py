@@ -32,6 +32,9 @@ def parse_args():
     p.add_argument("--workdir", default="runs")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--num-classes", type=int, default=None,
+                   help="override the config's class count (synthetic "
+                        "task-metric gates train with few classes)")
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--synthetic-size", type=int, default=2048,
                    help="synthetic dataset size when no --data-dir")
@@ -75,6 +78,8 @@ def main():
     cfg = get_config(args.model)
     if args.batch_size:
         cfg["batch_size"] = args.batch_size
+    if args.num_classes:
+        cfg["num_classes"] = args.num_classes
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     if cfg["dataset"].startswith("gan"):
         run_gan(args, cfg, dtype)
