@@ -14,12 +14,32 @@ hand (ref: YOLO/tensorflow/train.py:131-151).
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Any, Callable, Protocol
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepvision_tpu.core.mesh import AXIS_DATA
+
+
+def compiler_options() -> dict | None:
+    """Per-compile XLA option overrides from ``DVT_COMPILER_OPTIONS``
+    (``k=v,k=v`` or a JSON object), applied to every compiled step.
+
+    Exists because some XLA knobs are DebugOptions fields that are NOT
+    registered as ``XLA_FLAGS`` env flags — e.g. the test harness raises
+    ``xla_cpu_collective_call_terminate_timeout_seconds`` this way: on a
+    loaded shared host the 8 virtual CPU devices can reach a collective
+    >40s apart and XLA hard-aborts the whole process (rendezvous.cc
+    "Exiting to ensure a consistent program state")."""
+    raw = os.environ.get("DVT_COMPILER_OPTIONS")
+    if not raw:
+        return None
+    if raw.lstrip().startswith("{"):
+        return json.loads(raw)
+    return dict(kv.split("=", 1) for kv in raw.split(",") if kv)
 
 
 class TrainStepFn(Protocol):
@@ -55,6 +75,7 @@ def compile_train_step(
         in_shardings=(state_sh, batch_sh, key_sh),
         out_shardings=(state_sh, NamedSharding(mesh, P())),
         donate_argnums=(0,) if donate_state else (),
+        compiler_options=compiler_options(),
     )
 
 
@@ -85,6 +106,7 @@ def compile_eval_step(step_fn, mesh: Mesh, *, batch_spec: P | None = None,
             NamedSharding(mesh, batch_spec),
         ),
         out_shardings=NamedSharding(mesh, P()),
+        compiler_options=compiler_options(),
     )
 
 
@@ -119,6 +141,7 @@ def compile_checked_train_step(
             NamedSharding(mesh, batch_spec),
             NamedSharding(mesh, P()),
         ),
+        compiler_options=compiler_options(),
     )
 
     def run(state, batch, key):

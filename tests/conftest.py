@@ -12,14 +12,20 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
 if "xla_cpu_collective_timeout_seconds" not in flags:
-    # XLA:CPU hard-aborts the whole process ("Exiting to ensure a
-    # consistent program state", rendezvous.cc) when the 8 virtual-device
-    # threads reach a collective more than ~40s apart — which heavyweight
-    # step tests (order-5 hourglass at 128²) can exceed on a loaded
-    # shared host. Raise the collective timeout so slow-but-progressing
-    # runs aren't killed.
     flags += " --xla_cpu_collective_timeout_seconds=1200"
 os.environ["XLA_FLAGS"] = flags
+# XLA:CPU hard-aborts the whole process ("Exiting to ensure a consistent
+# program state", rendezvous.cc) when the 8 virtual-device threads reach
+# a collective more than ~40s apart — which heavyweight step tests
+# (order-5 hourglass at 128²) exceed on a loaded shared host. The
+# rendezvous terminate timeout is a DebugOptions field NOT registered as
+# an XLA_FLAGS flag, so it rides the framework's per-compile override
+# hook (core/step.compiler_options) instead.
+os.environ.setdefault(
+    "DVT_COMPILER_OPTIONS",
+    "xla_cpu_collective_call_terminate_timeout_seconds=1200"
+    ",xla_cpu_collective_call_warn_stuck_seconds=120",
+)
 # Keep tf (host data pipelines) off any accelerator and quiet.
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
