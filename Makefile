@@ -58,6 +58,24 @@ eval_classification:
 curves_%:
 	$(PY) predict.py curves --workdir $(WORKDIR)/$* -o $*-curves.png
 
+# reference checkpoint -> Orbax (CKPT=path/to/ref.pt MODEL=resnet50)
+convert:
+	$(PY) -m deepvision_tpu.convert $(CKPT) -m $(MODEL) -o $(WORKDIR)
+
+# synthetic task-metric gates: train to convergence on the hermetic
+# synthetic sets, then score with the real eval metrics (mAP / PCK)
+gate_detection:
+	$(PY) train.py -m yolov3 --num-classes 5 --lr 1e-3 --batch-size 32 \
+		--epochs 30 --synthetic-size 1024 --workdir $(WORKDIR)/gates
+	$(PY) evaluate.py detection -m yolov3 --num-classes 5 \
+		--workdir $(WORKDIR)/gates/yolov3
+
+gate_pose:
+	$(PY) train.py -m hourglass104 --epochs 30 --synthetic-size 256 \
+		--workdir $(WORKDIR)/gates
+	$(PY) evaluate.py pose -m hourglass104 \
+		--workdir $(WORKDIR)/gates/hourglass104
+
 find-python:
 	ps -ef | grep python
 
