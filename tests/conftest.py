@@ -48,6 +48,19 @@ def mesh8():
     return create_mesh(8, 1)
 
 
+@pytest.fixture(scope="session")
+def mesh1():
+    """Collective-free mesh for heavyweight CONVERGENCE tests: XLA:CPU
+    hard-aborts the process when 8 device threads reach a collective
+    >40s apart (rendezvous.cc), which the biggest step programs can hit
+    on a loaded host; convergence properties don't need sharding, and
+    sharded execution is covered by cheap single-step smokes +
+    __graft_entry__.dryrun_multichip."""
+    from deepvision_tpu.core import create_mesh
+
+    return create_mesh(1, 1)
+
+
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
