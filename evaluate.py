@@ -229,7 +229,9 @@ def cmd_pose(args):
         )
 
         size = min(size, 128)
-        imgs, kx, ky, v = synthetic_pose(32, size=size)
+        imgs, kx, ky, v = synthetic_pose(
+            32, size=size, num_joints=args.num_joints or 16
+        )
         batches = synthetic_pose_batches(imgs, kx, ky, v, args.batch_size)
 
     state = None
@@ -288,6 +290,8 @@ def main(argv=None):
 
     sp = sub.add_parser("pose")
     sp.add_argument("-m", "--model", default="hourglass104")
+    sp.add_argument("--num-joints", type=int, default=None,
+                    help="synthetic joint count (match training)")
     sp.add_argument("--workdir", default=None)
     sp.add_argument("--data-dir", default=None)
     sp.add_argument("--split", default="val")

@@ -35,6 +35,12 @@ def parse_args():
     p.add_argument("--num-classes", type=int, default=None,
                    help="override the config's class count (synthetic "
                         "task-metric gates train with few classes)")
+    p.add_argument("--lr", type=float, default=None,
+                   help="override the config's base learning rate")
+    p.add_argument("--num-joints", type=int, default=None,
+                   help="override the pose configs' joint count (the "
+                        "synthetic set is fully learnable at 3 joints — "
+                        "one per color channel)")
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
     p.add_argument("--synthetic-size", type=int, default=2048,
                    help="synthetic dataset size when no --data-dir")
@@ -80,6 +86,10 @@ def main():
         cfg["batch_size"] = args.batch_size
     if args.num_classes:
         cfg["num_classes"] = args.num_classes
+    if args.lr:
+        cfg["optimizer_params"]["lr"] = args.lr
+    if args.num_joints and "num_heatmaps" in cfg:
+        cfg["num_heatmaps"] = args.num_joints
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
     if cfg["dataset"].startswith("gan"):
         run_gan(args, cfg, dtype)
@@ -114,7 +124,9 @@ def main():
 
             n = args.synthetic_size
             size = min(size, 128)  # keep the synthetic smoke config small
-            imgs, kx, ky, v = synthetic_pose(n, size=size)
+            imgs, kx, ky, v = synthetic_pose(
+                n, size=size, num_joints=cfg["num_heatmaps"]
+            )
             split = max(cfg["batch_size"], int(n * 0.1))
             train_data = lambda e: synthetic_pose_batches(
                 imgs[split:], kx[split:], ky[split:], v[split:],
