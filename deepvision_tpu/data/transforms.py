@@ -141,7 +141,9 @@ class ColorJitter:
         fs = self._factor(rng, self.saturation) if self.saturation else 1.0
         img = apply_color_jitter(image.astype(np.float32), fb, fc, fs)
         if image.dtype == np.uint8:
-            return np.clip(img, 0, 255).astype(np.uint8)
+            # round-then-clip matches the tf.data twin (tf.round) and PIL;
+            # plain astype would truncate and drift 1 LSB
+            return np.clip(np.round(img), 0, 255).astype(np.uint8)
         return img
 
 

@@ -122,8 +122,8 @@ class InceptionV1(nn.Module):
             x = local_response_norm(x, size=64, alpha=1e-4, beta=0.75, k=1.0)
         x = conv(64, (1, 1), dtype=d, name="stem2")(x, train)
         x = conv(192, (3, 3), dtype=d, name="stem3")(x, train)
-        if not self.bn:  # ref: inception_v1.py:38,85
-            x = local_response_norm(x, size=64, alpha=1e-4, beta=0.75, k=1.0)
+        if not self.bn:  # ref: inception_v1.py:38,84 — LRN window = 192 chans
+            x = local_response_norm(x, size=192, alpha=1e-4, beta=0.75, k=1.0)
         x = layers.max_pool(x, (3, 3), (2, 2), padding="SAME")
 
         mod = lambda *c, name: InceptionModule(*c, dtype=d, bn=self.bn,

@@ -32,22 +32,39 @@ from deepvision_tpu.ops.lrn import local_response_norm
 ROW_TILE = 256  # rows of the flattened (B·H·W, C) view per kernel instance
 
 
+# Wide windows (Inception's stem LRN has size=192 over 192 channels)
+# switch the window sum from unrolled lane rotations — whose ~size live
+# temporaries blow the scoped-VMEM stack — to one banded matmul on the
+# MXU: acc = sq @ W with W[j, i] = 1 iff j is inside channel i's window.
+MATMUL_WINDOW_MIN = 16
+
+
 def _lrn_kernel(x_ref, o_ref, *, size, alpha, beta, k):
     x = x_ref[...].astype(jnp.float32)
     sq = x * x
     half = size // 2
     c = x.shape[-1]
-    acc = sq
-    # shifted adds over the channel (lane) axis; window is centered with
-    # torch semantics (half left, size-1-half right), zero-padded edges
-    for off in range(-half, size - half):
-        if off == 0:
-            continue
-        shifted = jnp.roll(sq, -off, axis=-1)
-        # zero the lanes that rolled around the edge
-        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
-        valid = (idx + off >= 0) & (idx + off < c)
-        acc = acc + jnp.where(valid, shifted, 0.0)
+    if size >= MATMUL_WINDOW_MIN:
+        # torch centering: window at channel i covers
+        # j in [i - half, i + size - 1 - half], clipped to [0, c)
+        j = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+        i = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+        band = ((j >= i - half) & (j <= i + size - 1 - half))
+        acc = jax.lax.dot(sq, band.astype(jnp.float32),
+                          precision=jax.lax.Precision.HIGHEST)
+    else:
+        acc = sq
+        # shifted adds over the channel (lane) axis; window is centered
+        # with torch semantics (half left, size-1-half right),
+        # zero-padded edges
+        for off in range(-half, size - half):
+            if off == 0:
+                continue
+            shifted = jnp.roll(sq, -off, axis=-1)
+            # zero the lanes that rolled around the edge
+            idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+            valid = (idx + off >= 0) & (idx + off < c)
+            acc = acc + jnp.where(valid, shifted, 0.0)
     denom = jnp.exp(beta * jnp.log(k + (alpha / size) * acc))
     o_ref[...] = (x / denom).astype(o_ref.dtype)
 

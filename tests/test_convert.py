@@ -529,7 +529,7 @@ class _TorchInceptionV1(tnn.Module):
         self.lrn1 = tnn.LocalResponseNorm(64)
         self.conv1x1 = _TorchBasicConv2d(64, 64, 1)
         self.conv3x3 = _TorchBasicConv2d(64, 192, 3, padding=1)
-        self.lrn2 = tnn.LocalResponseNorm(64)
+        self.lrn2 = tnn.LocalResponseNorm(192)
         self.maxpool2 = tnn.MaxPool2d(3, 2, ceil_mode=True)
         self.inception_3a = _TorchInceptionModule(192, 64, 96, 128, 16, 32, 32)
         self.inception_3b = _TorchInceptionModule(256, 128, 128, 192, 32, 96, 64)

@@ -354,6 +354,37 @@ def test_lrn_pallas_odd_channels_and_tile_remainder():
     )
 
 
+def test_lrn_pallas_wide_window_matmul_path():
+    """size >= MATMUL_WINDOW_MIN takes the banded-MXU-matmul window sum
+    (the unrolled-rotation form blows scoped VMEM at Inception's stem
+    LRN size=192 — caught on the real chip r4); parity with the jnp
+    lowering must hold, including windows wider than the channel count
+    clip at the edges."""
+    import jax.numpy as jnp
+
+    from deepvision_tpu.ops.lrn import local_response_norm
+    from deepvision_tpu.ops.lrn_pallas import local_response_norm_pallas
+
+    r = np.random.default_rng(2)
+    # Inception stem shape class: c == size == 192 (full-width window)
+    x = jnp.array(r.normal(0, 2, (2, 4, 4, 192)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(local_response_norm_pallas(x, 192, 1e-4, 0.75, 1.0,
+                                              True)),
+        np.asarray(local_response_norm(x, 192, 1e-4, 0.75, 1.0,
+                                       impl="jnp")),
+        atol=1e-5, rtol=1e-5,
+    )
+    # window narrower than c but still on the matmul path
+    x = jnp.array(r.normal(0, 1, (1, 5, 5, 96)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(local_response_norm_pallas(x, 64, 1e-4, 0.75, 2.0,
+                                              True)),
+        np.asarray(local_response_norm(x, 64, impl="jnp")),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
 def test_nms_candidate_tripwire_counts_threshold_clearers(rng):
     boxes = _random_boxes(rng, 12)
     scores = np.concatenate([
