@@ -1,20 +1,29 @@
-"""Pre-decoded raw-crop TFRecords: the input-pipeline fast path.
+"""Pre-decoded raw-frame TFRecords: the input-pipeline fast path.
 
 The JPEG pipeline is host-decode-bound (~a few hundred img/s per host
 core — SURVEY §7 hard part #1; the reference never hit this because its
 GPUs were slower than its CPUs, ref: ResNet/tensorflow/data_load.py:35-193
 is the decode path being bypassed). This builder runs the decode +
-aspect-preserving resize ONCE offline, storing fixed-size raw uint8
-crops; the training-time reader is then a parse + reshape — no JPEG
+aspect-preserving resize ONCE offline, storing the FULL resized uint8
+frame; the training-time reader is then a parse + reshape — no JPEG
 work — so feeding scales with disk/memory bandwidth instead of CPU.
 
-Records keep augmentation diversity: the stored crop is the ``stored``²
-center region (default 256², the resize floor), and the reader still
-applies the random ``size``² crop + flip per epoch.
+Augmentation coverage is exactly the JPEG path's: the stored frame is
+the complete shorter-side-``stored`` resize (variable long side,
+center-capped at 2:1 aspect — see ``jpeg_record_to_raw``), so the
+reader's random ``size``² crop + flip sees the same support region
+``random_crop`` reaches online (ref semantics:
+ResNet/tensorflow/data_load.py:35-193). Earlier revisions stored only
+the center ``stored``² square, which silently cut off-center content
+for non-square images; tests/test_data_pipeline.py::
+test_raw_frame_full_crop_support pins the full-support property on a
+wide image now, and readers refuse to auto-enable on legacy sidecars
+(no ``full_frame`` flag).
 
-Schema: ``image/raw`` (stored·stored·3 uint8 bytes),
+Schema: ``image/raw`` (height·width·3 uint8 bytes),
 ``image/class/label`` (int, [1,1000] like the reference builder's),
-``image/height``/``image/width`` (= stored, for validation).
+``image/height``/``image/width`` (actual stored dims; the reader
+reshapes per-record, so legacy square records stay readable).
 """
 
 from __future__ import annotations
@@ -31,8 +40,15 @@ def _tf():
     return tf
 
 
-def jpeg_record_to_raw(serialized: bytes, stored: int) -> dict | None:
-    """One reference-schema JPEG Example -> raw-crop feature dict."""
+def jpeg_record_to_raw(serialized: bytes, stored: int,
+                       max_aspect: float = 2.0) -> dict | None:
+    """One reference-schema JPEG Example -> raw-frame feature dict.
+
+    Stores the full aspect-preserving resize (shorter side = ``stored``).
+    ``max_aspect`` caps the long side at ``stored * max_aspect`` via a
+    center crop — beyond 2:1 the extreme margins contribute little and
+    the bytes grow linearly; the cap is recorded per-record in the
+    height/width features so nothing is silent."""
     tf = _tf()
     feats = tf.io.parse_single_example(
         serialized,
@@ -48,15 +64,18 @@ def jpeg_record_to_raw(serialized: bytes, stored: int) -> dict | None:
     new_h = tf.cast(tf.math.ceil(h * scale), tf.int32)
     new_w = tf.cast(tf.math.ceil(w * scale), tf.int32)
     image = tf.image.resize(tf.cast(image, tf.float32), [new_h, new_w])
-    off_h = (new_h - stored) // 2
-    off_w = (new_w - stored) // 2
-    image = tf.slice(image, [off_h, off_w, 0], [stored, stored, 3])
+    cap = int(round(stored * max_aspect))
+    keep_h = tf.minimum(new_h, cap)
+    keep_w = tf.minimum(new_w, cap)
+    off_h = (new_h - keep_h) // 2
+    off_w = (new_w - keep_w) // 2
+    image = tf.slice(image, [off_h, off_w, 0], [keep_h, keep_w, 3])
     raw = tf.cast(tf.clip_by_value(tf.round(image), 0, 255), tf.uint8)
     return {
         "image/raw": [raw.numpy().tobytes()],
         "image/class/label": [int(feats["image/class/label"].numpy())],
-        "image/height": [stored],
-        "image/width": [stored],
+        "image/height": [int(keep_h.numpy())],
+        "image/width": [int(keep_w.numpy())],
     }
 
 
@@ -93,6 +112,7 @@ def build_raw_crops(
     import json
 
     (Path(output_dir) / f"raw-{split}.meta.json").write_text(
-        json.dumps({"stored": stored, "count": len(items)})
+        json.dumps({"stored": stored, "count": len(items),
+                    "full_frame": True})
     )
     return len(items)

@@ -71,7 +71,13 @@ def color_jitter(image, fb, fc, fs):
 
 def _random_jitter(image, amount: float):
     """Sample PIL-enhance factors in [max(0, 1−a), 1+a] (transforms.py
-    twin semantics) and apply; rounds through uint8 range like PIL does."""
+    twin semantics) and apply; rounds through uint8 range like PIL does.
+
+    Known divergence from torchvision.ColorJitter: the three factors are
+    applied in FIXED brightness→contrast→saturation order, while
+    torchvision shuffles the order per sample. The factor distributions
+    are identical; only the composition order differs (the operators
+    nearly commute — brightness is a pure scale)."""
     tf = _tf()
     lo = max(0.0, 1.0 - amount)
     fb, fc, fs = (
@@ -143,23 +149,33 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     return image, label
 
 
-def parse_raw_crop(serialized, size: int, stored: int, is_training: bool,
+def parse_raw_crop(serialized, size: int, is_training: bool,
                    augment: str = "tf"):
-    """One pre-decoded raw-crop Example (data/builders/raw_crops.py) ->
+    """One pre-decoded raw-frame Example (data/builders/raw_crops.py) ->
     (uint8 image [size,size,3], int32 label). No JPEG decode: parse +
     reshape + random crop/flip only — the fast path when the host CPU,
-    not the record format, bounds feeding. ColorJitter (augment="pt")
-    still applies; normalization always runs on device (uint8 wire)."""
+    not the record format, bounds feeding. The frame is reshaped from
+    the per-record height/width features (full shorter-side-``stored``
+    resize, variable long side), so the random crop samples the same
+    support region the JPEG path's ``random_crop`` does. ColorJitter
+    (augment="pt") still applies; normalization always runs on device
+    (uint8 wire)."""
+    if augment not in ("tf", "pt"):
+        raise ValueError(f"unknown augment lineage {augment!r}")
     tf = _tf()
     feats = tf.io.parse_single_example(
         serialized,
         {
             "image/raw": tf.io.FixedLenFeature([], tf.string),
             "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+            "image/height": tf.io.FixedLenFeature([], tf.int64),
+            "image/width": tf.io.FixedLenFeature([], tf.int64),
         },
     )
+    h = tf.cast(feats["image/height"], tf.int32)
+    w = tf.cast(feats["image/width"], tf.int32)
     image = tf.reshape(
-        tf.io.decode_raw(feats["image/raw"], tf.uint8), [stored, stored, 3]
+        tf.io.decode_raw(feats["image/raw"], tf.uint8), [h, w, 3]
     )
     if is_training:
         image = tf.image.random_crop(image, [size, size, 3])
@@ -168,8 +184,9 @@ def parse_raw_crop(serialized, size: int, stored: int, is_training: bool,
             jittered = _random_jitter(tf.cast(image, tf.float32), PT_JITTER)
             image = tf.cast(jittered, tf.uint8)
     else:
-        off = (stored - size) // 2
-        image = tf.slice(image, [off, off, 0], [size, size, 3])
+        off_h = (h - size) // 2
+        off_w = (w - size) // 2
+        image = tf.slice(image, [off_h, off_w, 0], [size, size, 3])
     label = tf.cast(feats["image/class/label"], tf.int32) - 1
     return image, label
 
@@ -227,7 +244,7 @@ def make_raw_dataset(
         )
     return _records_pipeline(
         file_pattern, batch_size,
-        lambda s: parse_raw_crop(s, size, stored, is_training, augment),
+        lambda s: parse_raw_crop(s, size, is_training, augment),
         is_training=is_training, shuffle_buffer=shuffle_buffer,
         num_process=num_process, process_index=process_index, seed=seed,
     )
@@ -273,6 +290,7 @@ def make_imagenet_data(
     data_dir: str, batch_size: int, size: int = 224,
     *, train_images: int = 1_281_167, val_images: int = 50_000,
     train_as_uint8: bool = True, augment: str = "tf",
+    use_raw: bool | None = None,
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
@@ -297,18 +315,43 @@ def make_imagenet_data(
         )
     local_bs = batch_size // nproc
 
-    # fast path: pre-decoded raw-crop shards (builders/raw_crops.py)
+    # fast path: pre-decoded raw-frame shards (builders/raw_crops.py)
     # bypass the JPEG decode bound — taken only when the requested crop
     # fits inside the stored region (sidecar written by the builder), so
-    # 299²-input models fall back to the JPEG path instead of crashing
+    # 299²-input models fall back to the JPEG path instead of crashing.
+    # use_raw: True forces it (error if absent), False disables, None
+    # auto-enables with a printed notice (advisor r3: file presence alone
+    # should never silently change the training distribution).
     raw_stored = None
+    raw_full = False
     meta_path = d / "raw-train.meta.json"
-    if meta_path.exists():
+    if use_raw is not False and meta_path.exists():
         import json
 
-        raw_stored = json.loads(meta_path.read_text()).get("stored")
+        meta = json.loads(meta_path.read_text())
+        raw_stored = meta.get("stored")
+        # legacy (pre-r4) shards stored only the center square — a
+        # narrower crop support than the JPEG path; never auto-enable
+        raw_full = bool(meta.get("full_frame"))
     have_raw = (raw_stored is not None and size < raw_stored
                 and any(d.glob("raw-train-*")))
+    if use_raw is True and not (have_raw and raw_full):
+        raise FileNotFoundError(
+            f"use_raw=True but no usable raw-train-* shards under {d} "
+            f"(stored={raw_stored}, crop={size}, "
+            f"full_frame={raw_full}; legacy center-square shards must be "
+            f"rebuilt with data/builders/raw_crops.py)"
+        )
+    if have_raw and not raw_full:
+        print(f"[data] raw-train-* shards under {d} are legacy "
+              f"center-square records (no full_frame in {meta_path.name}) "
+              f"— falling back to JPEG records; rebuild with "
+              f"data/builders/raw_crops.py to re-enable the fast path")
+        have_raw = False
+    if have_raw and use_raw is None:
+        print(f"[data] raw-frame fast path ENABLED (raw-train-* + "
+              f"{meta_path.name}, stored={raw_stored}); pass "
+              f"use_raw=False / --no-raw to read the JPEG records instead")
 
     def train_data(epoch: int):
         # Multi-host (train_dist.py): each process reads a DISJOINT file

@@ -192,6 +192,15 @@ def test_color_jitter_tf_matches_numpy_twin():
         want = apply_color_jitter(img, fb, fc, fs)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-2)
 
+        # uint8 round-trip parity (advisor r3): both sides must ROUND,
+        # not truncate — truncation drifts 1 LSB on ~half the pixels
+        tf_u8 = tf.cast(
+            tf.clip_by_value(tf.round(tf.constant(got)), 0.0, 255.0),
+            tf.uint8).numpy()
+        np_u8 = np.clip(np.round(want), 0, 255).astype(np.uint8)
+        mism = np.mean(tf_u8 != np_u8)
+        assert mism < 0.001, f"uint8 round-trip diverges on {mism:.2%}"
+
 
 def test_torch_normalize_matches_host_f32_path():
     """Device-side uint8 torch normalization == host f32 mean/std path."""
@@ -276,3 +285,70 @@ def test_raw_crop_builder_and_reader(fake_imagenet, tmp_path):
                                  is_training=True, seed=0)
     timgs, _ = next(iter(raw_train.as_numpy_iterator()))
     assert timgs.shape == (4, 224, 224, 3) and timgs.dtype == np.uint8
+
+
+def test_raw_frame_full_crop_support(tmp_path):
+    """The raw fast path must expose the SAME crop-support region the
+    JPEG path's random_crop reaches (r3 verdict: the old center-square
+    storage silently cut off-center content for non-square images).
+    A wide image is stored as the full shorter-side-256 resize (long
+    side center-capped at 2:1), pixel-equal to the online resize."""
+    import tensorflow as tf
+
+    from deepvision_tpu.data.builders.imagenet import (
+        build_imagenet_tfrecords,
+    )
+    from deepvision_tpu.data.builders.raw_crops import build_raw_crops
+    from deepvision_tpu.data.imagenet import make_raw_dataset
+    from deepvision_tpu.data.tfrecord import decode_example, read_records
+
+    root = tmp_path / "wide"
+    (root / "train").mkdir(parents=True)
+    (root / "synsets.txt").write_text("n00000000\n")
+    rng = np.random.default_rng(7)
+    # 200x500: scale 1.28 -> 256x640 resize, capped to 256x512 stored
+    arr = rng.integers(0, 255, (200, 500, 3), np.uint8)
+    Image.fromarray(arr).save(root / "train" / "n00000000_0.JPEG", "JPEG")
+
+    out = tmp_path / "records"
+    build_imagenet_tfrecords(root / "train", root / "synsets.txt", out,
+                             "train", num_shards=1, num_workers=1)
+    build_raw_crops(out, out, split="train", stored=256, num_shards=1,
+                    num_workers=1)
+
+    [rec] = [decode_example(r)
+             for r in read_records(out / "raw-train-00000-of-00001")]
+    h, w = rec["image/height"][0], rec["image/width"][0]
+    assert h == 256 and w == 512, (h, w)  # full width kept (to the cap)
+    frame = np.frombuffer(rec["image/raw"][0], np.uint8).reshape(h, w, 3)
+
+    # pixel parity with the online JPEG-path resize of the SAME source
+    [jrec] = [decode_example(r)
+              for r in read_records(out / "train-00000-of-00001")]
+    dec = tf.io.decode_jpeg(jrec["image/encoded"][0], channels=3)
+    online = tf.image.resize(tf.cast(dec, tf.float32), [256, 640])
+    online = tf.cast(tf.clip_by_value(tf.round(online), 0, 255), tf.uint8)
+    online = online[:, 64:576]  # the builder's 2:1 center cap
+    np.testing.assert_array_equal(frame, online.numpy())
+    # off-center content IS in the stored support: the outer thirds
+    # differ from the center square (would be unreachable pre-fix)
+    assert not np.array_equal(frame[:, :128], frame[:, 128:256])
+
+    # reader crops anywhere in the full frame: with center-square-only
+    # storage every crop's column offset (in full-frame coords) would
+    # sit in [128, 160]; finding one outside proves off-center reach
+    wide_cols = False
+    for seed in range(8):
+        ds = make_raw_dataset(str(out / "raw-train-*"), 1, 224,
+                              is_training=True, seed=seed)
+        img, _ = next(iter(ds.as_numpy_iterator()))
+        # locate the crop's (row, col) offset by matching its first row
+        # (forward and flipped — the reader flips after cropping)
+        for row in (img[0, 0], img[0, 0][::-1]):
+            for roff in range(h - 224 + 1):
+                for off in range(w - 224 + 1):
+                    if np.array_equal(frame[roff, off:off + 224], row):
+                        if off < 128 or off > 160:
+                            wide_cols = True
+                        break
+    assert wide_cols, "random crops never left the center square"
