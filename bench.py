@@ -187,36 +187,33 @@ def _median_spread(vals):
     return round(med, 1), round(spread, 1)
 
 
-def _run_fed(state, step, mesh, key, batch_size, n_chips, make_ds):
-    """Median-of-FED_REPEATS fed throughput for one dataset factory.
+def _run_fed_once(state, step, mesh, key, batch_size, n_chips, make_ds,
+                  seed):
+    """One fed-throughput repetition for one dataset factory.
 
-    Returns ``(median, spread_pct, state)`` — the step donates its input
-    state, so the caller MUST thread the returned state into any further
-    step calls (reusing the donated original raises InvalidArgument)."""
+    Returns ``(rate, state)`` — the step donates its input state, so the
+    caller MUST thread the returned state into any further step calls
+    (reusing the donated original raises InvalidArgument)."""
     from deepvision_tpu.data.device_put import device_prefetch
 
-    rates = []
-    for rep in range(FED_REPEATS):
-        ds = make_ds(seed=rep)
-        it = ds.as_numpy_iterator()
+    ds = make_ds(seed=seed)
+    it = ds.as_numpy_iterator()
 
-        def host_batches():
-            for _ in range(FED_WARMUP + FED_STEPS):
-                img, lbl = next(it)
-                yield {"image": img, "label": lbl}
+    def host_batches():
+        for _ in range(FED_WARMUP + FED_STEPS):
+            img, lbl = next(it)
+            yield {"image": img, "label": lbl}
 
-        t0 = None
-        for i, dbatch in enumerate(device_prefetch(host_batches(), mesh)):
-            if i == FED_WARMUP:
-                float(state.params["fc"]["bias"][0])  # drain warmup
-                t0 = time.perf_counter()
-            key, sub = jax.random.split(key)
-            state, _ = step(state, dbatch, sub)
-        float(state.params["fc"]["bias"][0])
-        dt = time.perf_counter() - t0
-        rates.append(FED_STEPS * batch_size / dt / n_chips)
-    med, spread = _median_spread(rates)
-    return med, spread, state
+    t0 = None
+    for i, dbatch in enumerate(device_prefetch(host_batches(), mesh)):
+        if i == FED_WARMUP:
+            float(state.params["fc"]["bias"][0])  # drain warmup
+            t0 = time.perf_counter()
+        key, sub = jax.random.split(key)
+        state, _ = step(state, dbatch, sub)
+    float(state.params["fc"]["bias"][0])
+    dt = time.perf_counter() - t0
+    return FED_STEPS * batch_size / dt / n_chips, state
 
 
 def _host_only_rate(ds, n_batches, batch_size):
@@ -238,7 +235,8 @@ def _pipeline_benches(state, step, mesh, key, batch_size, n_chips) -> dict:
         root.mkdir(parents=True, exist_ok=True)
         _write_synthetic_tfrecords(root, PIPELINE_IMAGES)
         done.touch()
-    raw_done = root / "RAW_COMPLETE"
+    # v2: full-frame raw records (r4 builder rework); old cache is stale
+    raw_done = root / "RAW_COMPLETE_v2"
     if not raw_done.exists():
         from deepvision_tpu.data.builders.raw_crops import build_raw_crops
 
@@ -258,12 +256,21 @@ def _pipeline_benches(state, step, mesh, key, batch_size, n_chips) -> dict:
         is_training=True, seed=seed,
     )
 
-    jpeg_fed, jpeg_spread, state = _run_fed(
-        state, step, mesh, key, batch_size, n_chips, jpeg_ds
-    )
-    raw_fed, raw_spread, state = _run_fed(
-        state, step, mesh, key, batch_size, n_chips, raw_ds
-    )
+    # INTERLEAVED A/B (J,R,J,R,…): the axon relay's throughput drifts on
+    # the scale of a bench run (r3 measured a 55.9% spread and raw<JPEG
+    # when all JPEG reps ran first); alternating pairs makes the
+    # comparison difference-in-pairs honest, and the per-rep rates are
+    # reported raw so drift is visible instead of folded into a median.
+    jpeg_rates, raw_rates = [], []
+    for rep in range(FED_REPEATS):
+        r, state = _run_fed_once(state, step, mesh, key, batch_size,
+                                 n_chips, jpeg_ds, seed=rep)
+        jpeg_rates.append(r)
+        r, state = _run_fed_once(state, step, mesh, key, batch_size,
+                                 n_chips, raw_ds, seed=rep)
+        raw_rates.append(r)
+    jpeg_fed, jpeg_spread = _median_spread(jpeg_rates)
+    raw_fed, raw_spread = _median_spread(raw_rates)
     host_jpeg = _host_only_rate(jpeg_ds(seed=99), 8, batch_size)
     host_raw = _host_only_rate(raw_ds(seed=99), 8, batch_size)
 
@@ -285,11 +292,13 @@ def _pipeline_benches(state, step, mesh, key, batch_size, n_chips) -> dict:
     return {
         "pipeline_fed_images_per_sec_per_chip": jpeg_fed,
         "pipeline_fed_spread_pct": jpeg_spread,
+        "pipeline_fed_rates": [round(r, 1) for r in jpeg_rates],
         "raw_record_fed_images_per_sec_per_chip": raw_fed,
         "raw_record_fed_spread_pct": raw_spread,
+        "raw_record_fed_rates": [round(r, 1) for r in raw_rates],
         "host_decode_ceiling_images_per_sec": round(host_jpeg, 1),
         "host_raw_ceiling_images_per_sec": round(host_raw, 1),
-        "h2d_link_gbps": round(h2d_gbps, 3),
+        "h2d_link_gbytes_per_sec": round(h2d_gbps, 3),
         "h2d_link_images_per_sec": round(h2d_img_rate, 1),
     }
 

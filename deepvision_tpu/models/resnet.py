@@ -39,10 +39,73 @@ from typing import Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from deepvision_tpu.models import layers
 from deepvision_tpu.models.layers import ConvBN, he_normal
 from deepvision_tpu.models.registry import register
+
+
+class _Conv7S2D(nn.Module):
+    """The 7x7/2 stem conv re-expressed over a 2x2 space-to-depth input
+    (the standard MLPerf TPU ResNet reformulation): 3-channel 224² maps
+    tile terribly onto the MXU's 8x128 lanes, so fold the stride-2
+    spatial structure into channels (12) and run a 4x4/1 VALID conv.
+
+    The PARAMETER stays the canonical ``[7,7,Cin,out]`` kernel (same
+    name/shape as ``nn.Conv`` — checkpoints and the torch converter are
+    unaffected); the layout transform is two reshapes per step on a
+    ~37 KB tensor. Numerically identical to the torch-padded 7x7/2 conv
+    (pinned by tests/test_models_classification.py).
+
+    Derivation (per spatial axis): torch pad 3 means output m reads
+    x[2m-3 .. 2m+3]. Pad x by (4, 2) so P[r'] = x[r'-4]; then the taps
+    are P[2m+1 .. 2m+7] ⊂ P[2(m+ki)+di] for ki∈[0,4), di∈{0,1} with
+    kernel row kr = 2ki+di-1 — i.e. the 7-tap kernel left-padded by one
+    zero row/col to 8 and reshaped (4,2,4,2,...)."""
+
+    features: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(f"s2d stem needs even H/W, got {(h, w)}")
+        kernel = self.param("kernel", he_normal,
+                            (7, 7, c, self.features), jnp.float32)
+        x = x.astype(self.dtype)
+        k = kernel.astype(self.dtype)
+
+        p = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
+        hp, wp = h + 6, w + 6
+        s = p.reshape(n, hp // 2, 2, wp // 2, 2, c)
+        s = s.transpose(0, 1, 3, 2, 4, 5).reshape(n, hp // 2, wp // 2,
+                                                  4 * c)
+
+        k8 = jnp.pad(k, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        k8 = k8.reshape(4, 2, 4, 2, c, self.features)
+        k8 = k8.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                    self.features)
+        return lax.conv_general_dilated(
+            s, k8, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
+class S2DStem(nn.Module):
+    """ConvBN-shaped stem (children ``conv``/``bn``, identical pytree)
+    computing the 7x7/2 conv via :class:`_Conv7S2D`."""
+
+    features: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _Conv7S2D(self.features, dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="bn")(x)
+        return nn.relu(x)
 
 
 class BasicBlock(nn.Module):
@@ -99,14 +162,19 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     always_project: bool = True
+    s2d_stem: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = ConvBN(self.num_filters, (7, 7), (2, 2),
-                   padding=((3, 3), (3, 3)),
-                   dtype=self.dtype, name="stem")(x, train)
+        if self.s2d_stem:
+            x = S2DStem(self.num_filters, dtype=self.dtype,
+                        name="stem")(x, train)
+        else:
+            x = ConvBN(self.num_filters, (7, 7), (2, 2),
+                       padding=((3, 3), (3, 3)),
+                       dtype=self.dtype, name="stem")(x, train)
         x = layers.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
         for i, n_blocks in enumerate(self.stage_sizes):
             feats = self.num_filters * (2 ** i)

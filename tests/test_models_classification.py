@@ -145,3 +145,62 @@ def test_parameter_count_parity():
         )
         got = sum(x.size for x in jax.tree.leaves(v["params"]))
         assert got == want, f"{name}: {got} != {want}"
+
+
+def test_s2d_stem_matches_plain_conv_stem():
+    """The space-to-depth stem (MLPerf TPU reformulation, models/resnet.
+    _Conv7S2D) is a pure layout transform: SAME param pytree as the
+    plain 7x7/2 ConvBN stem and numerically identical outputs — so
+    checkpoints/converters are unaffected and it can be toggled freely
+    for throughput."""
+    import jax.numpy as jnp
+
+    plain = get_model("resnet50", num_classes=7)
+    s2d = get_model("resnet50", num_classes=7, s2d_stem=True)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 64, 64, 3)).astype(np.float32)
+
+    v_plain = plain.init(jax.random.key(1), x, train=True)
+    v_s2d = s2d.init(jax.random.key(1), x, train=True)
+    # identical pytree structure and shapes (checkpoint compatibility)
+    assert (jax.tree_util.tree_structure(v_plain)
+            == jax.tree_util.tree_structure(v_s2d))
+    assert all(
+        a.shape == b.shape
+        for a, b in zip(jax.tree.leaves(v_plain), jax.tree.leaves(v_s2d))
+    )
+
+    # the stem itself is exact to float noise (~1e-6 from reduction
+    # order: 4x4x12 vs 7x7x3 accumulation)
+    import flax.linen as nn
+
+    from deepvision_tpu.models.layers import he_normal
+    from deepvision_tpu.models.resnet import _Conv7S2D
+
+    conv = nn.Conv(64, (7, 7), strides=(2, 2), padding=((3, 3), (3, 3)),
+                   use_bias=False, kernel_init=he_normal)
+    vc = conv.init(jax.random.key(2), x)
+    y_ref = conv.apply(vc, x)
+    y_s2d_stem = _Conv7S2D(64).apply(
+        {"params": {"kernel": vc["params"]["kernel"]}}, x)
+    np.testing.assert_allclose(np.asarray(y_s2d_stem), np.asarray(y_ref),
+                               rtol=1e-3, atol=1e-5)
+
+    # same params -> same logits; train-mode tolerances are loose
+    # because 16 train-mode BNs amplify the stem's 1e-6 float noise
+    y_plain = plain.apply(v_plain, x)
+    y_s2d = s2d.apply(v_plain, x)
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_plain),
+                               rtol=1e-4, atol=1e-4)
+
+    (yp, updp) = plain.apply(v_plain, x, train=True,
+                             mutable=["batch_stats"])
+    (ys, upds) = s2d.apply(v_plain, x, train=True,
+                           mutable=["batch_stats"])
+    scale = np.abs(np.asarray(yp)).max()
+    np.testing.assert_allclose(np.asarray(ys) / scale,
+                               np.asarray(yp) / scale, atol=5e-3)
+    for a, b in zip(jax.tree.leaves(updp), jax.tree.leaves(upds)):
+        a, b = np.asarray(a), np.asarray(b)
+        sc = max(np.abs(a).max(), 1.0)
+        np.testing.assert_allclose(b / sc, a / sc, atol=5e-3)
