@@ -248,8 +248,21 @@ def make_detection_data(
 
     ``steps_per_epoch`` bounds the repeated training stream (= dataset
     size // batch for the reference's epoch semantics).
+
+    Multi-process contract = data/imagenet.make_imagenet_data's:
+    ``batch_size`` is GLOBAL; training file-shards per process and
+    batches the local share; validation streams the SAME full set per
+    process at the global batch and slices its own row block.
     """
+    import jax
+
     d = Path(data_dir)
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    if batch_size % nproc:
+        raise ValueError(f"global batch {batch_size} not divisible by "
+                         f"{nproc} processes")
+    local_bs = batch_size // nproc
 
     def _iter(ds, limit=None, pad_to=None):
         for i, (img, boxes, lbl) in enumerate(ds.as_numpy_iterator()):
@@ -262,8 +275,8 @@ def make_detection_data(
 
     def train_data(epoch: int):
         ds = make_detection_dataset(
-            str(d / train_pattern), batch_size, size, is_training=True,
-            seed=epoch,
+            str(d / train_pattern), local_bs, size, is_training=True,
+            num_process=nproc, process_index=pid, seed=epoch,
         )
         return _iter(ds, limit=steps_per_epoch)
 
@@ -271,6 +284,8 @@ def make_detection_data(
         ds = make_detection_dataset(
             str(d / val_pattern), batch_size, size, is_training=False
         )
-        return _iter(ds, pad_to=batch_size)
+        for batch in _iter(ds, pad_to=batch_size):
+            yield {k: v[pid * local_bs:(pid + 1) * local_bs]
+                   for k, v in batch.items()}
 
     return train_data, val_data, steps_per_epoch

@@ -188,14 +188,29 @@ def make_pose_data(
     *, train_pattern: str = "train-*", val_pattern: str = "val-*",
     steps_per_epoch: int,
 ):
-    """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch)."""
+    """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
+
+    Multi-process contract = data/imagenet.make_imagenet_data's:
+    ``batch_size`` is GLOBAL; training file-shards per process and
+    batches the local share; validation streams the SAME full set per
+    process at the global batch and slices its own row block (file
+    sharding there would deadlock the collective eval on uneven
+    shard sizes)."""
+    import jax
+
     d = Path(data_dir)
     keys = ("image", "kx", "ky", "v")
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    if batch_size % nproc:
+        raise ValueError(f"global batch {batch_size} not divisible by "
+                         f"{nproc} processes")
+    local_bs = batch_size // nproc
 
     def train_data(epoch: int):
         ds = make_pose_dataset(
-            str(d / train_pattern), batch_size, size, is_training=True,
-            seed=epoch,
+            str(d / train_pattern), local_bs, size, is_training=True,
+            num_process=nproc, process_index=pid, seed=epoch,
         )
         return iter_tf_batches(ds, keys, limit=steps_per_epoch)
 
@@ -203,6 +218,8 @@ def make_pose_data(
         ds = make_pose_dataset(
             str(d / val_pattern), batch_size, size, is_training=False
         )
-        return iter_tf_batches(ds, keys, pad_to=batch_size)
+        for batch in iter_tf_batches(ds, keys, pad_to=batch_size):
+            yield {k: v[pid * local_bs:(pid + 1) * local_bs]
+                   for k, v in batch.items()}
 
     return train_data, val_data, steps_per_epoch

@@ -161,3 +161,136 @@ def test_val_slices_tile_the_global_stream(dist_run):
     np.testing.assert_array_equal(
         np.concatenate([s["label"] for s in slices]), lbl[: len(got)]
     )
+
+
+# --------------------------------------------------- the REAL launcher
+
+@pytest.fixture(scope="module")
+def launcher_run(tmp_path_factory):
+    """Run the SHIPPED ``train_dist.py`` (not a worker re-implementation)
+    as 2 real jax.distributed processes on a BatchNorm model, plus a
+    single-process ``train.py`` reference with identical flags — the two
+    code paths the r3 verdict called untested: the launcher's flag
+    peeling / initialize wiring / delegation (train_dist.py:35-64), and
+    cross-process global-batch BN semantics (SURVEY §7 hard part #3)."""
+    root = tmp_path_factory.mktemp("launcher")
+    repo = Path(__file__).resolve().parents[1]
+    port = _free_port()
+
+    def env_for(n_devices: int) -> dict:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n_devices}"
+        )
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(repo), env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+        env.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+        env["CUDA_VISIBLE_DEVICES"] = "-1"
+        return env
+
+    # ONE train step (16 synthetic rows = 8 val + 8 train): the untuned
+    # net's gradients are so large (init loss ~21, catastrophic BN-
+    # backward cancellation) that ANY multi-step trajectory amplifies
+    # cross-process vs in-process reduction-order float noise into
+    # percent-level drift; a single step compares cleanly and still
+    # pins the global-batch BN property
+    flags = ["-m", "resnet34", "--num-classes", "4", "--input-size", "32",
+             "--batch-size", "8", "--synthetic-size", "16", "--epochs",
+             "1", "--precision", "f32", "--lr", "1e-4"]
+
+    dist_wd = root / "dist"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(repo / "train_dist.py"),
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             "--platform", "cpu",
+             *flags, "--workdir", str(dist_wd)],
+            env=env_for(2), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(2)
+    ]
+    logs = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=900)
+        logs.append(stdout)
+    assert all(p.returncode == 0 for p in procs), (
+        "launcher run failed:\n" + "\n---- p1 ----\n".join(logs)
+    )
+
+    single_wd = root / "single"
+    single = subprocess.run(
+        [sys.executable, str(repo / "train.py"), *flags,
+         "--platform", "cpu", "--workdir", str(single_wd)],
+        env=env_for(4), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=900,
+    )
+    assert single.returncode == 0, single.stdout
+    return logs, single.stdout, dist_wd, single_wd
+
+
+def _epoch_metrics(log: str) -> dict:
+    out = {}
+    for line in log.splitlines():
+        if line.startswith("[epoch ") and "]" in line and "=" in line:
+            for kv in line.split("]", 1)[1].split():
+                k, _, v = kv.partition("=")
+                try:
+                    out.setdefault(k, []).append(float(v))
+                except ValueError:
+                    pass
+    return out
+
+
+def test_launcher_wiring_and_losses(launcher_run):
+    logs, single_log, _, _ = launcher_run
+    assert "process 0/2: 2 local / 4 global devices" in logs[0]
+    assert "process 1/2: 2 local / 4 global devices" in logs[1]
+    m0, m1, ms = (_epoch_metrics(x) for x in (*logs, single_log))
+    assert m0["val_loss"] and m0["train_loss"]
+    # replicated metrics agree across the two launcher processes…
+    assert m0["train_loss"] == m1["train_loss"]
+    assert m0["val_loss"] == m1["val_loss"]
+    # …and match the single-process run on the same global batches
+    np.testing.assert_allclose(m0["train_loss"], ms["train_loss"],
+                               rtol=2e-3)
+    np.testing.assert_allclose(m0["val_loss"], ms["val_loss"], rtol=2e-3)
+
+
+def test_launcher_batch_stats_match_single_process(launcher_run):
+    """Cross-process BN: the 2-process run's saved batch_stats equal the
+    single-process run's (global-batch statistics via GSPMD collectives,
+    not per-process stats)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.state import create_train_state
+
+    _, _, dist_wd, single_wd = launcher_run
+    model = get_model("resnet34", num_classes=4, dtype=jnp.float32)
+    stats = []
+    for wd in (dist_wd, single_wd):
+        state = create_train_state(
+            model, optax.sgd(0.1), np.zeros((1, 32, 32, 3), np.float32))
+        mgr = CheckpointManager(wd / "resnet34" / "ckpt")
+        state, _ = mgr.restore_inference(state)
+        mgr.close()
+        stats.append(state.batch_stats)
+    flat_d, flat_s = (
+        {"/".join(map(str, k)): np.asarray(v)
+         for k, v in jax.tree_util.tree_flatten_with_path(s)[0]}
+        for s in stats
+    )
+    assert flat_d.keys() == flat_s.keys() and flat_d
+    moved = False
+    for k in flat_d:
+        np.testing.assert_allclose(flat_d[k], flat_s[k], rtol=1e-3,
+                                   atol=1e-4, err_msg=k)
+        if "mean" in k and np.abs(flat_d[k]).max() > 1e-3:
+            moved = True
+    assert moved, "batch_stats never updated — BN did not run"

@@ -37,11 +37,24 @@ def parse_args():
                         "task-metric gates train with few classes)")
     p.add_argument("--lr", type=float, default=None,
                    help="override the config's base learning rate")
+    p.add_argument("--input-size", type=int, default=None,
+                   help="override the config's train-time crop size "
+                        "(small-input smoke runs, launcher tests)")
     p.add_argument("--num-joints", type=int, default=None,
                    help="override the pose configs' joint count (the "
                         "synthetic set is fully learnable at 3 joints — "
                         "one per color channel)")
     p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. 'cpu' for smoke runs; "
+                        "jax.config wins over the JAX_PLATFORMS env var, "
+                        "which site hooks may pin)")
+    p.add_argument("--raw", dest="use_raw", action="store_true",
+                   default=None,
+                   help="require the pre-decoded raw-frame fast path "
+                        "(data/builders/raw_crops.py); error if absent")
+    p.add_argument("--no-raw", dest="use_raw", action="store_false",
+                   help="read JPEG records even if raw-frame shards exist")
     p.add_argument("--synthetic-size", type=int, default=2048,
                    help="synthetic dataset size when no --data-dir")
     p.add_argument("--steps-per-epoch", type=int, default=None,
@@ -75,6 +88,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
     from deepvision_tpu.core import create_mesh
     from deepvision_tpu.data.mnist import batches, load_mnist_idx, synthetic_mnist
     from deepvision_tpu.models import get_model
@@ -90,7 +106,16 @@ def main():
         cfg["optimizer_params"]["lr"] = args.lr
     if args.num_joints and "num_heatmaps" in cfg:
         cfg["num_heatmaps"] = args.num_joints
+    if args.input_size:
+        cfg["input_size"] = args.input_size
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    if args.use_raw is not None and not (
+            args.data_dir and cfg["dataset"] == "imagenet"):
+        raise SystemExit(
+            "--raw/--no-raw only applies to --data-dir ImageNet configs "
+            f"(this run: dataset={cfg['dataset']!r}, "
+            f"data_dir={args.data_dir!r})"
+        )
     if cfg["dataset"].startswith("gan"):
         run_gan(args, cfg, dtype)
         return
@@ -187,6 +212,7 @@ def main():
         train_data, val_data, steps = make_imagenet_data(
             args.data_dir, cfg["batch_size"], size,
             augment=cfg.get("augment", "tf"),
+            use_raw=args.use_raw,
         )
     elif args.data_dir and cfg["dataset"] == "mnist":
         import os
@@ -240,6 +266,20 @@ def main():
                                  normalize_kind="torch"),
         }
 
+    if jax.process_count() > 1 and (not args.data_dir
+                                    or cfg["dataset"] == "mnist"):
+        # In-memory synthetic datasets generate the SAME global batches
+        # in every process (seeded rng); core.shard_batch treats its
+        # input as the process-LOCAL share, so each process must feed
+        # only its disjoint row block — else a 2-process run would
+        # silently train on a 2x global batch of duplicated rows. The
+        # tf.data --data-dir paths (imagenet/pose/detection) instead
+        # file-shard per process inside their make_*_data factories.
+        train_data, val_data = (
+            _localize_batches(f, jax.process_count(), jax.process_index())
+            for f in (train_data, val_data)
+        )
+
     mesh = create_mesh()
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     trainer = Trainer(
@@ -255,6 +295,24 @@ def main():
         print(f"resumed at epoch {trainer.start_epoch}")
     trainer.fit(args.epochs)
     _maybe_publish(args, f"{args.workdir}/{args.model}/ckpt")
+
+
+def _localize_batches(data_fn, nproc: int, pid: int):
+    """Wrap a batch-iterator factory so every yielded batch is this
+    process's row block (rows [pid·b/n, (pid+1)·b/n) of each globally
+    identical batch)."""
+
+    def wrapped(*a):
+        for batch in data_fn(*a):
+            n = next(iter(batch.values())).shape[0]
+            if n % nproc:
+                raise ValueError(
+                    f"batch of {n} rows not divisible by {nproc} processes"
+                )
+            lb = n // nproc
+            yield {k: v[pid * lb:(pid + 1) * lb] for k, v in batch.items()}
+
+    return wrapped
 
 
 def _maybe_publish(args, ckpt_dir: str):

@@ -40,10 +40,16 @@ def main():
                         "— auto-detected from the TPU metadata)")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. 'cpu' for local "
+                        "multi-process testing; jax.config wins over the "
+                        "JAX_PLATFORMS env var, which site hooks may pin)")
     dist_args, train_argv = p.parse_known_args()
 
     import jax
 
+    if dist_args.platform:
+        jax.config.update("jax_platforms", dist_args.platform)
     kwargs = {}
     if dist_args.coordinator:
         kwargs = dict(
