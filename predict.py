@@ -322,6 +322,8 @@ def cmd_export(args):
     from deepvision_tpu.export import export_forward, save_exported
 
     size, channels = _model_geometry(args.model)
+    if getattr(args, "size", None):
+        size = args.size
     sample = np.zeros((1, size, size, channels), np.float32)
     state = load_state(args.model, args.workdir, sample,
                        num_classes=args.num_classes)
@@ -387,6 +389,9 @@ def main(argv=None):
     common(sp, model="resnet50", images=False)
     sp.add_argument("-o", "--output", default=None)
     sp.add_argument("--num-classes", type=int, default=1000)
+    sp.add_argument("--size", type=int, default=None,
+                    help="override the config input size (must match "
+                         "training, e.g. rehearsal --input-size runs)")
     sp.set_defaults(fn=cmd_export)
 
     args = p.parse_args(argv)

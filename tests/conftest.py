@@ -64,3 +64,60 @@ def mesh1():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------- tiering
+# Two tiers (VERDICT r3 weak #6): `pytest -m smoke` is the <5-min-on-a-
+# 1-core-box tier; the full suite (default, no -m) stays the CI bar.
+# Central registry instead of per-file decorators so the r3 durations
+# report maps 1:1 onto this list.
+
+_SLOW_TESTS = {
+    # convergence / training-loop tests (minutes each)
+    "test_yolo_train_step_learns",
+    "test_pose_train_step_learns",
+    "test_centernet_train_step_learns",
+    "test_cyclegan_train_step",
+    "test_dcgan_train_step_updates_both_and_learns",
+    "test_centernet_sharded_step_smoke",
+    "test_evaluate_detection_cli_runs",
+    "test_evaluate_pose_cli_runs",
+    "test_evaluate_gan_cyclegan_plumbing",
+    "test_s2d_stem_matches_plain_conv_stem",
+    # heavyweight model/infra tests (15-130s each)
+    "test_centernet_output_shapes",
+    "test_hourglass_output_shapes",
+    "test_hourglass_stacks_differ",
+    "test_pool_matches_reference_semantics",
+    "test_resume_reproduces_uninterrupted_run",
+    "test_cyclegan_models_shapes",
+    "test_yolo_loss_three_scales_additive",
+    "test_yolov3_output_shapes",
+    "test_predict_restores_trainer_checkpoint",
+    "test_restore_inference_ignores_optimizer_mismatch",
+    "test_converter_cli_end_to_end",
+    "test_keras_h5_roundtrip",
+    "test_converted_tree_matches_init",
+    "test_weight_update_sharding_matches_replicated",
+    "test_dcgan_shapes",
+    "test_predict_detect_draws",
+}
+# whole modules that spawn real subprocesses (jax.distributed workers)
+_SLOW_MODULES = {"test_distributed"}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "smoke: fast tier (<5 min total on a 1-core box)")
+    config.addinivalue_line(
+        "markers", "slow: convergence/e2e tests; excluded from -m smoke")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if (item.originalname if hasattr(item, "originalname")
+                else item.name) in _SLOW_TESTS \
+                or item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.smoke)

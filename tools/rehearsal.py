@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""One-command real-data rehearsal: the full operator path on generated
+JPEGs, exactly as ImageNet day would run it (VERDICT r3 missing #1 —
+make real-data day a data swap, not a debug session).
+
+    python tools/rehearsal.py [--workdir DIR] [--platform cpu]
+
+Chain (each step a real subprocess through the shipped CLIs):
+  1. generate a JPEG folder (non-square images, 2 synsets) + synsets.txt
+  2. deepvision_tpu.data.builders.imagenet  -> train/validation TFRecords
+  3. deepvision_tpu.data.builders.raw_crops -> raw-frame fast-path shards
+  4. train.py   -m resnet34 --data-dir ...  (raw fast path auto-enables)
+  5. evaluate.py classification             (masked full-set top-1/5)
+  6. predict.py export                      (StableHLO artifact)
+
+The checkpoint-converter leg (reference .pt -> Orbax -> logit parity) is
+covered by ``make rehearsal``'s pytest step — the rehearsal of
+converting the author's published checkpoints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def sh(*cmd: str) -> str:
+    print("+", " ".join(cmd), flush=True)
+    r = subprocess.run(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True)
+    sys.stdout.write(r.stdout)
+    if r.returncode != 0:
+        raise SystemExit(f"step failed (rc={r.returncode})")
+    return r.stdout
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--workdir", default="/tmp/dvt_rehearsal")
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform for the train/eval steps")
+    p.add_argument("--size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=1)
+    args = p.parse_args()
+
+    root = Path(args.workdir)
+    if root.exists():
+        shutil.rmtree(root)
+    (root / "imgs").mkdir(parents=True)
+
+    # 1. JPEG folder: deliberately non-square (wide AND tall) so the
+    # raw-frame builder's full-support storage is exercised
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    synsets = ["n00000000", "n00000001"]
+    (root / "synsets.txt").write_text("\n".join(synsets) + "\n")
+    for i in range(16):
+        h, w = (120, 260) if i % 2 else (260, 120)
+        arr = rng.integers(0, 255, (h, w, 3), np.uint8)
+        # learnable class signal: channel-0 brightness
+        arr[..., 0] = arr[..., 0] // 2 + (i % 2) * 120
+        Image.fromarray(arr).save(
+            root / "imgs" / f"{synsets[i % 2]}_{i}.JPEG", "JPEG")
+
+    # 2-3. records + raw-frame shards through the builder CLIs
+    records = root / "records"
+    build = ("from deepvision_tpu.data.builders.imagenet import "
+             "build_imagenet_tfrecords as b; "
+             f"b(r'{root}/imgs', r'{root}/synsets.txt', r'{records}', "
+             "'%s', num_shards=2, num_workers=1)")
+    sh(sys.executable, "-c", build % "train")
+    sh(sys.executable, "-c", build % "validation")
+    sh(sys.executable, "-c",
+       "from deepvision_tpu.data.builders.raw_crops import "
+       "build_raw_crops as b; "
+       f"b(r'{records}', r'{records}', split='train', num_shards=2, "
+       "num_workers=1)")
+
+    # 4. train through the shipped CLI (raw fast path auto-enables with
+    # the printed notice)
+    plat = ["--platform", args.platform] if args.platform else []
+    out = sh(sys.executable, "train.py", "-m", "resnet34",
+             "--data-dir", str(records), "--workdir", str(root / "runs"),
+             "--num-classes", "2", "--input-size", str(args.size),
+             "--batch-size", "8", "--epochs", str(args.epochs),
+             "--precision", "f32", "--lr", "1e-3", *plat)
+    assert "raw-frame fast path ENABLED" in out, "fast path did not engage"
+
+    # 5. offline evaluation against the checkpoint
+    out = sh(sys.executable, "evaluate.py", "classification",
+             "-m", "resnet34", "--workdir", str(root / "runs" / "resnet34"),
+             "--data-dir", str(records), "--num-classes", "2",
+             "--input-size", str(args.size), "--batch-size", "8")
+    metrics = json.loads(
+        [ln for ln in out.splitlines() if ln.startswith("{")][-1])
+    assert metrics["images"] == 16, metrics
+
+    # 6. deployment export
+    sh(sys.executable, "predict.py", "export", "-m", "resnet34",
+       "--workdir", str(root / "runs" / "resnet34"),
+       "--size", str(args.size), "--num-classes", "2",
+       "-o", str(root / "resnet34.stablehlo"))
+    assert (root / "resnet34.stablehlo").stat().st_size > 0
+
+    print("REHEARSAL OK:", json.dumps(metrics))
+
+
+if __name__ == "__main__":
+    main()
