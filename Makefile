@@ -67,12 +67,34 @@ convert:
 	$(PY) -m deepvision_tpu.convert $(CKPT) -m $(MODEL) -o $(WORKDIR)
 
 # synthetic task-metric gates: train to convergence on the hermetic
-# synthetic sets, then score with the real eval metrics (mAP / PCK)
+# synthetic sets, then score with the real eval metrics (mAP / PCK).
+# Data sizes follow the measured r3/r4 scaling: 1024 imgs plateaued at
+# mAP 0.67, 2048 overfit (train 0.61 / val 4.32) at 0.856; the 4096
+# recipe reached 0.88 by epoch 24 with train~val (EVIDENCE.md r4)
 gate_detection:
 	$(PY) train.py -m yolov3 --num-classes 5 --lr 1e-3 --batch-size 32 \
-		--epochs 30 --synthetic-size 1024 --workdir $(WORKDIR)/gates
+		--epochs 50 --synthetic-size 4096 --workdir $(WORKDIR)/gates
 	$(PY) evaluate.py detection -m yolov3 --num-classes 5 \
 		--workdir $(WORKDIR)/gates/yolov3
+
+# two-phase recipe from EVIDENCE.md r4: the plateau scheduler never
+# fires on this task (val micro-improves each epoch), so the CenterNet-
+# paper x10 lr drop is applied manually via resume
+gate_centernet:
+	$(PY) train.py -m centernet --num-classes 5 --epochs 50 \
+		--synthetic-size 1024 --workdir $(WORKDIR)/gates
+	$(PY) train.py -m centernet --num-classes 5 --epochs 65 --lr 1e-4 \
+		--synthetic-size 1024 --workdir $(WORKDIR)/gates --resume
+	$(PY) evaluate.py detection -m centernet --num-classes 5 --size 128 \
+		--workdir $(WORKDIR)/gates/centernet
+
+gate_gan:
+	$(PY) train.py -m cyclegan --synthetic-size 256 --epochs 40 \
+		--workdir $(WORKDIR)/gates
+	$(PY) evaluate.py gan -m cyclegan --workdir $(WORKDIR)/gates/cyclegan
+	$(PY) train.py -m dcgan --synthetic-size 2048 --epochs 20 \
+		--workdir $(WORKDIR)/gates
+	$(PY) evaluate.py gan -m dcgan --workdir $(WORKDIR)/gates/dcgan
 
 gate_pose:
 	$(PY) train.py -m hourglass104 --epochs 30 --synthetic-size 256 \

@@ -290,7 +290,7 @@ def make_imagenet_data(
     data_dir: str, batch_size: int, size: int = 224,
     *, train_images: int = 1_281_167, val_images: int = 50_000,
     train_as_uint8: bool = True, augment: str = "tf",
-    use_raw: bool | None = None,
+    use_raw: bool | None = None, steps_per_epoch: int | None = None,
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
@@ -305,7 +305,11 @@ def make_imagenet_data(
     import jax
 
     d = Path(data_dir)
-    steps = train_images // batch_size  # batch_size is the GLOBAL batch
+    # batch_size is the GLOBAL batch. The repeated training stream has no
+    # intrinsic epoch, so this limit IS the epoch length — overridable
+    # for subset runs (the full-ImageNet default once trained a rehearsal
+    # set of 16 images for 160k steps/epoch)
+    steps = steps_per_epoch or train_images // batch_size
     nproc = jax.process_count()
     pid = jax.process_index()
     if batch_size % nproc:

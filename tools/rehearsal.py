@@ -92,6 +92,7 @@ def main() -> None:
              "--data-dir", str(records), "--workdir", str(root / "runs"),
              "--num-classes", "2", "--input-size", str(args.size),
              "--batch-size", "8", "--epochs", str(args.epochs),
+             "--steps-per-epoch", "2",  # 16 images, not an ImageNet epoch
              "--precision", "f32", "--lr", "1e-3", *plat)
     assert "raw-frame fast path ENABLED" in out, "fast path did not engage"
 

@@ -58,7 +58,9 @@ def parse_args():
     p.add_argument("--synthetic-size", type=int, default=2048,
                    help="synthetic dataset size when no --data-dir")
     p.add_argument("--steps-per-epoch", type=int, default=None,
-                   help="train steps per epoch (detection datasets)")
+                   help="override train steps per epoch (subset runs; "
+                        "the ImageNet reader otherwise assumes the full "
+                        "1.28M-image epoch)")
     p.add_argument("--output-bucket", default=None,
                    help="GCS bucket to publish the final checkpoint to "
                         "(ref: Hourglass/tensorflow/main.py:50-65)")
@@ -213,6 +215,7 @@ def main():
             args.data_dir, cfg["batch_size"], size,
             augment=cfg.get("augment", "tf"),
             use_raw=args.use_raw,
+            steps_per_epoch=args.steps_per_epoch,
         )
     elif args.data_dir and cfg["dataset"] == "mnist":
         import os
@@ -265,6 +268,18 @@ def main():
             "eval_step": partial(classification_eval_step,
                                  normalize_kind="torch"),
         }
+
+    if args.steps_per_epoch:
+        steps = args.steps_per_epoch
+        if not args.data_dir or cfg["dataset"] == "mnist":
+            # the tf.data paths bake the limit into their readers; the
+            # in-memory iterators must be truncated here or the LR
+            # schedule (built from `steps`) would desynchronize from the
+            # actual epoch length
+            from itertools import islice
+
+            train_data = (lambda f: lambda e: islice(f(e), steps))(
+                train_data)
 
     if jax.process_count() > 1 and (not args.data_dir
                                     or cfg["dataset"] == "mnist"):
