@@ -239,6 +239,11 @@ TRAINING_CONFIG: dict[str, dict] = {
         "dataset": "pose",
         "optimizer": "adam",
         "optimizer_params": {"lr": 1e-4},
+        # bf16 cripples this net: the heatmap regression has unbounded
+        # f32-scale outputs and the deep recursive hourglass compounds
+        # bf16 rounding — measured r4: 30 epochs of the synthetic gate
+        # reached loss 74 in bf16 vs 5.1 in f32 (logs/gate_pose_r4*.log)
+        "precision": "f32",
         # mode "max" on the Trainer's negated val loss (the yolov3
         # convention): lower loss -> higher metric -> improvement
         "scheduler": "plateau",

@@ -44,7 +44,9 @@ def parse_args():
                    help="override the pose configs' joint count (the "
                         "synthetic set is fully learnable at 3 joints — "
                         "one per color channel)")
-    p.add_argument("--precision", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--precision", default=None, choices=["bf16", "f32"],
+                   help="compute dtype (default: the model config's "
+                        "'precision', else bf16)")
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. 'cpu' for smoke runs; "
                         "jax.config wins over the JAX_PLATFORMS env var, "
@@ -110,7 +112,12 @@ def main():
         cfg["num_heatmaps"] = args.num_joints
     if args.input_size:
         cfg["input_size"] = args.input_size
-    dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    from deepvision_tpu.core.precision import get_precision
+
+    # get_precision validates config-sourced names (argparse choices only
+    # cover the CLI flag) and normalizes aliases like "bfloat16"
+    dtype = get_precision(
+        args.precision or cfg.get("precision", "bf16")).compute_dtype
     if args.use_raw is not None and not (
             args.data_dir and cfg["dataset"] == "imagenet"):
         raise SystemExit(
