@@ -98,10 +98,13 @@ gate_gan:
 
 # --num-joints 3: the synthetic set encodes one joint per color channel
 # (data/pose.synthetic_pose); at the MPII default of 16 the channel
-# assignment j%3 is ambiguous and no model can score high PCK
+# assignment j%3 is ambiguous and no model can score high PCK.
+# 1024 images + lr 1e-3: 256 images generalization-capped PCK at ~0.5
+# (37% gross misses on held-out draws) and the config lr of 1e-4
+# converged 5x slower (EVIDENCE.md r4)
 gate_pose:
-	$(PY) train.py -m hourglass104 --num-joints 3 --epochs 30 \
-		--synthetic-size 256 --workdir $(WORKDIR)/gates
+	$(PY) train.py -m hourglass104 --num-joints 3 --epochs 120 \
+		--synthetic-size 1024 --lr 1e-3 --workdir $(WORKDIR)/gates
 	$(PY) evaluate.py pose -m hourglass104 --num-joints 3 \
 		--workdir $(WORKDIR)/gates/hourglass104
 

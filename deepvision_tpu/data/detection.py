@@ -222,8 +222,13 @@ def synthetic_detection(
 
 
 def synthetic_batches(images, boxes, labels, batch_size, *, rng=None,
-                      drop_remainder=True):
-    """Epoch iterator over the synthetic arrays (mask-padded eval tail)."""
+                      drop_remainder=True, augment=False):
+    """Epoch iterator over the synthetic arrays (mask-padded eval tail).
+
+    ``augment`` adds the record pipeline's horizontal flip (per-sample
+    coin from ``rng``; image columns reversed, box cx -> 1-cx on real
+    rows) — the r4 YOLO gates showed the un-augmented synthetic path
+    overfits 2-4x sooner than the flip-augmented record path would."""
     n = len(images)
     idx = np.arange(n)
     if rng is not None:
@@ -231,9 +236,14 @@ def synthetic_batches(images, boxes, labels, batch_size, *, rng=None,
     end = n - n % batch_size if drop_remainder else n
     for s in range(0, end, batch_size):
         sel = idx[s : s + batch_size]
-        batch = {
-            "image": images[sel], "boxes": boxes[sel], "label": labels[sel]
-        }
+        # fancy indexing yields fresh copies, so in-place flips are safe
+        img, box, lbl = images[sel], boxes[sel], labels[sel]
+        if augment and rng is not None:
+            flip = rng.random(len(sel)) < 0.5
+            img[flip] = img[flip, :, ::-1]
+            real = (lbl >= 0) & flip[:, None]
+            box[..., 0] = np.where(real, 1.0 - box[..., 0], box[..., 0])
+        batch = {"image": img, "boxes": box, "label": lbl}
         if not drop_remainder:
             batch = pad_partial_batch(batch, batch_size)
         yield batch

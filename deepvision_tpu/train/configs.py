@@ -260,7 +260,12 @@ def get_config(name: str) -> dict:
     base = name
     if name.endswith("_ref") and name[:-4] in TRAINING_CONFIG:
         base = name[:-4]
-    cfg = dict(TRAINING_CONFIG[base])
+    # deep copy: callers override nested entries (train.py writes
+    # optimizer_params["lr"] from --lr), and a shallow dict() would let
+    # those writes contaminate the global table across in-process runs
+    import copy
+
+    cfg = copy.deepcopy(TRAINING_CONFIG[base])
     cfg.setdefault("input_size", 224)
     cfg.setdefault("channels", 3)
     cfg.setdefault("num_classes", 1000)

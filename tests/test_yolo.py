@@ -418,3 +418,52 @@ def test_yolo_train_step_learns(mesh8):
     )
     assert float(part["count"]) == 6
     assert np.isfinite(float(part["loss_sum"]))
+
+
+def test_synthetic_batches_flip_augment_moves_boxes_with_pixels():
+    """augment=True mirrors image columns and box centers together on
+    real rows only; padded rows (label -1) keep their zero boxes, and
+    eval mode (no rng) never augments."""
+    from deepvision_tpu.data.detection import (
+        synthetic_batches,
+        synthetic_detection,
+    )
+
+    imgs, boxes, labels = synthetic_detection(32, size=64, num_classes=3,
+                                              seed=3)
+    [b] = list(synthetic_batches(imgs, boxes, labels, 32,
+                                 rng=np.random.default_rng(0),
+                                 augment=True))
+    # find which rows flipped by matching image content against the
+    # originals (shuffle makes row order differ; noise images are unique)
+    flipped = unflipped = 0
+    for i in range(32):
+        src = fl = None
+        for j in range(32):
+            if np.array_equal(b["image"][i], imgs[j]):
+                src, fl = j, False
+                break
+            if np.array_equal(b["image"][i], imgs[j][:, ::-1]):
+                src, fl = j, True
+                break
+        assert src is not None, f"row {i} matches no source image"
+        if not fl:
+            unflipped += 1
+            np.testing.assert_array_equal(b["boxes"][i], boxes[src])
+        else:
+            flipped += 1
+            real = labels[src] >= 0
+            np.testing.assert_allclose(
+                b["boxes"][i][real, 0], 1.0 - boxes[src][real, 0],
+                rtol=1e-6)
+            # padded rows untouched (cx stays 0, not 1)
+            np.testing.assert_array_equal(b["boxes"][i][~real],
+                                          boxes[src][~real])
+            # y/w/h unchanged everywhere
+            np.testing.assert_array_equal(b["boxes"][i][:, 1:],
+                                          boxes[src][:, 1:])
+    assert flipped and unflipped  # both modes exercised
+
+    # no rng (eval) -> identity even with augment requested
+    [be] = list(synthetic_batches(imgs, boxes, labels, 32, augment=True))
+    np.testing.assert_array_equal(be["image"], imgs)

@@ -208,6 +208,7 @@ def main():
             train_data = lambda e: synthetic_batches(
                 imgs[split:], boxes[split:], labels[split:],
                 cfg["batch_size"], rng=np.random.default_rng(e),
+                augment=True,
             )
             val_data = lambda: synthetic_batches(
                 imgs[:split], boxes[:split], labels[:split],
