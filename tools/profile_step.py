@@ -13,6 +13,7 @@ intensity so compute- vs memory-bound is attributable at a glance.
 from __future__ import annotations
 
 import glob
+import os
 import json
 import sys
 import time
@@ -34,7 +35,14 @@ def build(model_name: str, batch: int):
 
     n = len(jax.devices())
     mesh = create_mesh(n, 1)
-    model = get_model(model_name, dtype=jnp.bfloat16)
+    from deepvision_tpu.train.configs import get_config
+
+    # profile the SHIPPED config (e.g. the resnet s2d stem) so traces
+    # match what bench.py measures; BENCH_S2D=0 reverts like bench.py
+    kwargs = dict(get_config(model_name).get("model_kwargs", {}))
+    if os.environ.get("BENCH_S2D") == "0":
+        kwargs.pop("s2d_stem", None)
+    model = get_model(model_name, dtype=jnp.bfloat16, **kwargs)
     rng = np.random.default_rng(0)
     b = {
         "image": rng.normal(size=(batch * n, 224, 224, 3)).astype(np.float32),

@@ -130,10 +130,12 @@ def main():
         return
     if cfg["dataset"] == "pose":
         model = get_model(args.model, dtype=dtype,
-                          num_heatmaps=cfg["num_heatmaps"])
+                          num_heatmaps=cfg["num_heatmaps"],
+                          **cfg.get("model_kwargs", {}))
     else:
         model = get_model(args.model, dtype=dtype,
-                          num_classes=cfg["num_classes"])
+                          num_classes=cfg["num_classes"],
+                          **cfg.get("model_kwargs", {}))
 
     size, ch = cfg["input_size"], cfg["channels"]
     step_fns = {}
