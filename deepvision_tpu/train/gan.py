@@ -424,11 +424,18 @@ def fit_gan(
     check_numerics: bool = False,
     shard_weight_update: bool = False,
     async_checkpoint: bool = False,
+    preempt=None,
 ):
     """Minimal GAN epoch loop: compiled step + loggers + TB + Orbax saves
     every ``save_every`` epochs keeping 3 (ref: DCGAN/tensorflow/main.py:39,
     80-83; CycleGAN saves every epoch with the epoch tracked in the
-    checkpoint, ref: train.py:329-333 — pass save_every=1)."""
+    checkpoint, ref: train.py:329-333 — pass save_every=1).
+
+    ``preempt``: optional zero-arg callable polled at every epoch
+    boundary; when truthy the loop saves off-cadence and stops (the GAN
+    analog of Trainer's SIGTERM handling — epoch-granular because GAN
+    epochs on the reference workloads are short; resume restarts at the
+    next epoch)."""
     from deepvision_tpu.core.step import (
         compile_checked_train_step,
         compile_train_step,
@@ -498,8 +505,12 @@ def fit_gan(
         print(f"[epoch {epoch}] " + " ".join(
             f"{k}={v:.4f}" for k, v in sorted(epoch_metrics.items())
         ) + f" time={time.time() - t0:.1f}s", flush=True)
-        if (epoch + 1) % save_every == 0 or epoch == epochs - 1:
+        stop = preempt is not None and preempt()
+        if (epoch + 1) % save_every == 0 or epoch == epochs - 1 or stop:
             mgr.save(epoch, state, loggers=loggers)
+        if stop:
+            print(f"[preempted] after completed epoch {epoch}", flush=True)
+            break
     tb.flush()
     mgr.close()
     return state, loggers

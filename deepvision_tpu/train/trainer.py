@@ -17,6 +17,8 @@ class over the compiled step functions:
 
 from __future__ import annotations
 
+import shutil
+import signal
 import time
 from pathlib import Path
 from typing import Callable, Iterable
@@ -107,18 +109,96 @@ class Trainer:
             keep_best_of="plateau_metric" if keep_best else None,
         )
         self.start_epoch = 0
+        self.start_step = 0  # mid-epoch resume point (preemption)
         self.best_metric = -float("inf")
+        # preemption (SURVEY §5.3 — the reference has no preemption
+        # handling at all): a signal flips _preempt; the step loop saves
+        # a synchronous mid-epoch checkpoint into ckpt_preempt/ and fit()
+        # returns with .preempted set so the launcher can exit 143.
+        self._preempt = False
+        self.preempted = False
         # per-epoch stream derived in train_epoch: _key is only valid
         # inside an epoch
         self._base_key = jax.random.key(seed + 1)
+
+    # -- preemption ------------------------------------------------------
+    @property
+    def _preempt_dir(self) -> Path:
+        return self.workdir / "ckpt_preempt"
+
+    def request_preempt(self, signum=None, frame=None) -> None:
+        """Async-signal-safe: only flips a flag; the step loop performs
+        the synchronous save at the next step boundary."""
+        self._preempt = True
+
+    def install_preemption_handler(self, signals=(signal.SIGTERM,)) -> None:
+        """Route SIGTERM (the TPU-VM/k8s preemption grace signal) into
+        :meth:`request_preempt`. Called by the CLI, not the ctor — a
+        library must not install process-wide handlers implicitly."""
+        for s in signals:
+            signal.signal(s, self.request_preempt)
+
+    def _save_preempt(self, epoch: int, step_in_epoch: int) -> None:
+        # separate sync manager + directory: a mid-epoch save must never
+        # enter the main manager's retention (keep_best would rank it by
+        # a metric it doesn't have) and must be committed before exit.
+        # Always start fresh: a second preemption of the SAME epoch
+        # (resume -> preempted again) would otherwise hit Orbax's
+        # step-already-exists error
+        self._clear_preempt_ckpt()
+        mgr = CheckpointManager(self._preempt_dir, max_to_keep=1)
+        try:
+            mgr.save(
+                epoch, self.state, loggers=self.loggers,
+                extra={
+                    "step_in_epoch": int(step_in_epoch),
+                    **({"plateau": self.plateau.state_dict()}
+                       if self.plateau else {}),
+                },
+                best_metric=self.best_metric,
+            )
+        finally:
+            mgr.close()
+        self.ckpt.wait_until_finished()  # commit in-flight async saves too
+        print(f"[preempted] saved epoch {epoch} step {step_in_epoch} "
+              f"to {self._preempt_dir}", flush=True)
+
+    def _clear_preempt_ckpt(self) -> None:
+        if self._preempt_dir.exists():
+            shutil.rmtree(self._preempt_dir, ignore_errors=True)
 
     # -- resume ----------------------------------------------------------
     def resume(self, epoch: int | None = None) -> None:
         """Restore latest (or given) checkpoint incl. host-side scheduler +
         metric history — the reference restores model/opt/scheduler/loggers
-        the same way (ref: ResNet/pytorch/train.py:293-307)."""
+        the same way (ref: ResNet/pytorch/train.py:293-307).
+
+        A preemption checkpoint (``ckpt_preempt/``, written by the SIGTERM
+        path) newer than the latest epoch checkpoint takes precedence and
+        resumes MID-epoch at its recorded step, bit-identical to the
+        uninterrupted run (epoch-seeded data order + replayed PRNG chain).
+        """
+        if epoch is None and self._preempt_dir.exists():
+            pmgr = CheckpointManager(self._preempt_dir, max_to_keep=1)
+            try:
+                p_epoch = pmgr.latest_epoch()
+                latest = self.ckpt.latest_epoch()
+                if p_epoch is not None and (latest is None
+                                            or p_epoch > latest):
+                    self.state, meta = pmgr.restore(self.state)
+                    self._apply_meta(meta)
+                    self.start_epoch = meta["epoch"]  # redo this epoch...
+                    self.start_step = meta["extra"]["step_in_epoch"]  # here
+                    return
+            finally:
+                pmgr.close()
+            self._clear_preempt_ckpt()  # stale (older than an epoch save)
         self.state, meta = self.ckpt.restore(self.state, epoch)
+        self._apply_meta(meta)
         self.start_epoch = meta["epoch"] + 1
+        self.start_step = 0
+
+    def _apply_meta(self, meta: dict) -> None:
         if meta.get("loggers"):
             self.loggers = meta["loggers"]
         extra = meta.get("extra", {})
@@ -132,11 +212,18 @@ class Trainer:
             self.best_metric = meta["best_metric"]
 
     # -- loops -----------------------------------------------------------
-    def train_epoch(self, epoch: int) -> dict:
+    def train_epoch(self, epoch: int, start_step: int = 0) -> dict | None:
+        """One epoch; ``start_step`` > 0 resumes mid-epoch after a
+        preemption (skips the first batches of the epoch-seeded stream and
+        replays the PRNG split chain, so the remaining steps are
+        bit-identical to the uninterrupted run). Returns None when
+        preempted mid-epoch (partial aggregates would be misleading)."""
         # epoch-derived PRNG stream: together with the epoch-seeded data
         # order this makes resume-at-epoch-N bit-identical to an
         # uninterrupted run reaching epoch N (dropout masks, GAN noise)
         self._key = jax.random.fold_in(self._base_key, epoch)
+        for _ in range(start_step):  # replay the consumed chain positions
+            self._key, _ = jax.random.split(self._key)
         t0 = time.perf_counter()
         counts: list[int] = []
         pending: list[dict] = []  # device scalars not yet fetched
@@ -149,7 +236,9 @@ class Trainer:
             pending.clear()
 
         def counted():
-            for batch in self.train_data(epoch):
+            for j, batch in enumerate(self.train_data(epoch)):
+                if j < start_step:  # host-side skip keeps the data order
+                    continue
                 counts.append(len(batch["image"]))
                 yield batch
 
@@ -163,6 +252,11 @@ class Trainer:
                 self.state, device_batch, sub
             )
             pending.append(metrics)
+            if self._preempt:
+                drain()  # park the dispatch queue before serializing
+                self._save_preempt(epoch, start_step + i + 1)
+                self.preempted = True
+                return None
             if self.log_every and i % self.log_every == 0:
                 drain()  # syncs mostly-finished work; O(n) fetches total
                 # true running mean over EVERY batch so far, matching the
@@ -203,13 +297,21 @@ class Trainer:
 
     def fit(self, epochs: int | None = None) -> Loggers:
         total = epochs or self.config.get("total_epochs", 1)
-        if self.start_epoch == 0:
+        if self.start_epoch == 0 and self.start_step == 0:
             val = self.validate()  # pre-train validation (ref: train.py:390)
             if val:
                 self.loggers.log_metrics(-1, val)
                 print(f"[pre-train] {_fmt(val)}", flush=True)
         for epoch in range(self.start_epoch, total):
-            tr = self.train_epoch(epoch)
+            start_step = (self.start_step
+                          if epoch == self.start_epoch else 0)
+            tr = self.train_epoch(epoch, start_step=start_step)
+            if tr is None:  # preempted mid-epoch; checkpoint already saved
+                return self.loggers
+            if start_step:
+                # honest history: this epoch's train aggregates cover only
+                # the post-resume tail of the epoch
+                tr["train_from_step"] = float(start_step)
             val = self.validate()
             epoch_metrics = {**tr, **val}
             self.loggers.log_metrics(epoch, epoch_metrics)
@@ -222,19 +324,28 @@ class Trainer:
 
             # plateau metric: accuracy when available, else negated loss
             # (the reference's detection trainers plateau on val loss,
-            # ref: YOLO/tensorflow/train.py:56-68)
+            # ref: YOLO/tensorflow/train.py:56-68). On a mid-epoch-resumed
+            # epoch WITHOUT validation the train loss covers only the
+            # epoch tail — feeding it to the scheduler would diverge from
+            # the uninterrupted run, so that epoch is skipped for
+            # plateau/best tracking (val-based metrics are unaffected:
+            # validation always runs on the full set).
             metric = val.get(
-                "val_top1", -val.get("val_loss", tr["train_loss"])
+                "val_top1",
+                -val["val_loss"] if "val_loss" in val
+                else (tr["train_loss"] if not start_step else None),
             )
-            if self.plateau is not None:
-                scale = self.plateau.update(metric)
-                if scale != float(
-                    self.state.opt_state.hyperparams["lr_scale"]
-                ):
-                    self.state = self.state.replace(
-                        opt_state=set_lr_scale(self.state.opt_state, scale)
-                    )
-            self.best_metric = max(self.best_metric, metric)
+            if metric is not None:
+                if self.plateau is not None:
+                    scale = self.plateau.update(metric)
+                    if scale != float(
+                        self.state.opt_state.hyperparams["lr_scale"]
+                    ):
+                        self.state = self.state.replace(
+                            opt_state=set_lr_scale(self.state.opt_state,
+                                                   scale)
+                        )
+                self.best_metric = max(self.best_metric, metric)
             self.ckpt.save(
                 epoch,
                 self.state,
@@ -242,10 +353,42 @@ class Trainer:
                 extra={"plateau": self.plateau.state_dict()}
                 if self.plateau else {},
                 best_metric=self.best_metric,
-                metrics={"plateau_metric": float(metric)},
+                # metric-less partial epoch: rank at the current best so
+                # keep_best retention neither drops nor promotes it
+                metrics={"plateau_metric": float(
+                    metric if metric is not None else self.best_metric)},
             )
+            # the epoch checkpoint supersedes any earlier preemption save —
+            # but only once it is DURABLE: an async save has merely been
+            # staged when save() returns, and deleting the preemption
+            # checkpoint before the commit would leave a kill window with
+            # no recent checkpoint at all. (The wait only triggers on the
+            # first epoch after a preemption resume.)
+            if self._preempt_dir.exists():
+                self.ckpt.wait_until_finished()
+                self._clear_preempt_ckpt()
+            if self._preempt:  # signal arrived during validate/save: the
+                self.preempted = True  # epoch is fully committed — stop
+                self.ckpt.wait_until_finished()
+                print(f"[preempted] after completed epoch {epoch}",
+                      flush=True)
+                return self.loggers
         self.ckpt.wait_until_finished()  # commit any in-flight async save
         return self.loggers
+
+
+def make_preempt_flag(signals=(signal.SIGTERM,)) -> Callable[[], bool]:
+    """Install handlers for ``signals`` and return a zero-arg callable
+    reporting whether one arrived — the preemption hook for loops that
+    are functions rather than Trainer instances (``fit_gan``)."""
+    fired = {"stop": False}
+
+    def handler(signum=None, frame=None):
+        fired["stop"] = True
+
+    for s in signals:
+        signal.signal(s, handler)
+    return lambda: fired["stop"]
 
 
 def _fmt(d: dict) -> str:

@@ -90,6 +90,8 @@ _SLOW_TESTS = {
     "test_hourglass_stacks_differ",
     "test_pool_matches_reference_semantics",
     "test_resume_reproduces_uninterrupted_run",
+    "test_preempt_resume_is_bit_identical",
+    "test_sigterm_subprocess_roundtrip",
     "test_cyclegan_models_shapes",
     "test_yolo_loss_three_scales_additive",
     "test_yolov3_output_shapes",

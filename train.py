@@ -317,8 +317,17 @@ def main():
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
-        print(f"resumed at epoch {trainer.start_epoch}")
+        print(f"resumed at epoch {trainer.start_epoch}"
+              + (f" step {trainer.start_step}" if trainer.start_step
+                 else ""))
+    # SIGTERM (TPU-VM / k8s preemption grace signal) -> synchronous
+    # mid-epoch checkpoint + exit 143; `--resume` picks it up and
+    # continues bit-identically (SURVEY §5.3 — the reference has no
+    # preemption story at all)
+    trainer.install_preemption_handler()
     trainer.fit(args.epochs)
+    if trainer.preempted:
+        raise SystemExit(143)
     _maybe_publish(args, f"{args.workdir}/{args.model}/ckpt")
 
 
@@ -438,6 +447,11 @@ def run_gan(args, cfg, dtype):
         step_fn = cyclegan_train_step
 
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
+    # SIGTERM -> stop at the next epoch boundary with an off-cadence save
+    # (same contract as Trainer.install_preemption_handler)
+    from deepvision_tpu.train.trainer import make_preempt_flag
+
+    preempted = make_preempt_flag()
     fit_gan(
         state, step_fn, train_data, mesh,
         epochs=epochs, workdir=workdir,
@@ -447,7 +461,10 @@ def run_gan(args, cfg, dtype):
         check_numerics=args.check_numerics,
         shard_weight_update=args.shard_weight_update,
         async_checkpoint=args.async_checkpoint,
+        preempt=preempted,
     )
+    if preempted():
+        raise SystemExit(143)
     _maybe_publish(args, f"{workdir}/ckpt")
 
 
