@@ -99,6 +99,7 @@ def cmd_classification(args):
         for batch in batches:
             if state is None:
                 state = _load(args.model, args.workdir, batch["image"][:1],
+                              epoch=args.epoch,
                               num_classes=cfg["num_classes"])
             yield step(state, shard_batch(mesh, batch))
 
@@ -154,7 +155,7 @@ def cmd_detection(args):
     for batch in batches:
         if state is None:
             state = _load(args.model, args.workdir, batch["image"][:1],
-                          num_classes=num_classes)
+                          epoch=args.epoch, num_classes=num_classes)
         preds = _apply(state, batch["image"])
         if is_centernet:
             # peak-NMS decode of the LAST stack (ops/centernet_decode —
@@ -243,6 +244,7 @@ def cmd_pose(args):
     for batch in batches:
         if state is None:
             state = _load(args.model, args.workdir, batch["image"][:1],
+                          epoch=args.epoch,
                           num_heatmaps=batch["kx"].shape[1])
         heat = np.asarray(_apply(state, batch["image"])[-1])  # last stack
         grid = heat.shape[1]
@@ -302,7 +304,7 @@ def cmd_gan(args):
             image_size=args.size,
         )
         mgr = CheckpointManager(f"{args.workdir}/ckpt")
-        state, meta = mgr.restore_inference(state)
+        state, meta = mgr.restore_inference(state, args.epoch)
         mgr.close()
         # held-out draw: training uses seed=0 (train.run_gan default)
         a, b = synthetic_unpaired(args.n, size=args.size, seed=113)
@@ -334,7 +336,7 @@ def cmd_gan(args):
             get_model("dcgan_generator"), get_model("dcgan_discriminator")
         )
         mgr = CheckpointManager(f"{args.workdir}/ckpt")
-        state, meta = mgr.restore_inference(state)
+        state, meta = mgr.restore_inference(state, args.epoch)
         mgr.close()
 
         # judge classifier: LeNet on the full 32² [-1,1] synthetic reals
@@ -402,6 +404,9 @@ def main(argv=None):
                     help="override class count (rehearsal/smoke sets)")
     sp.add_argument("--input-size", type=int, default=None,
                     help="override eval crop (must match training)")
+    sp.add_argument("--epoch", type=int, default=None,
+                    help="saved epoch to score (default latest; with "
+                         "--keep-best the best is often not the newest)")
     sp.set_defaults(fn=cmd_classification)
 
     sp = sub.add_parser("detection")
@@ -418,6 +423,9 @@ def main(argv=None):
     sp.add_argument("--iou", type=float, default=0.5)
     sp.add_argument("--ap-method", default="area",
                     choices=["area", "11point"])
+    sp.add_argument("--epoch", type=int, default=None,
+                    help="saved epoch to score (default latest; with "
+                         "--keep-best the best is often not the newest)")
     sp.set_defaults(fn=cmd_detection)
 
     sp = sub.add_parser("pose")
@@ -433,6 +441,9 @@ def main(argv=None):
     sp.add_argument("--norm", type=float, default=0.1,
                     help="PCK reference length as a fraction of the "
                          "normalized crop (0.1 ≈ head fraction)")
+    sp.add_argument("--epoch", type=int, default=None,
+                    help="saved epoch to score (default latest; with "
+                         "--keep-best the best is often not the newest)")
     sp.set_defaults(fn=cmd_pose)
 
     sp = sub.add_parser("gan")
@@ -442,6 +453,9 @@ def main(argv=None):
     sp.add_argument("--size", type=int, default=64)
     sp.add_argument("--n", type=int, default=256,
                     help="held-out images (cyclegan) / samples (dcgan)")
+    sp.add_argument("--epoch", type=int, default=None,
+                    help="saved epoch to score (default latest; with "
+                         "--keep-best the best is often not the newest)")
     sp.set_defaults(fn=cmd_gan)
 
     args = p.parse_args(argv)

@@ -101,7 +101,11 @@ _PALETTE = [(255, 64, 64), (64, 255, 64), (64, 64, 255), (255, 255, 64),
 # ------------------------------------------------------ model loading
 
 
-def load_state(model_name: str, workdir: str | None, sample, **model_kw):
+def load_state(model_name: str, workdir: str | None, sample, epoch=None,
+               **model_kw):
+    """``epoch``: a specific saved epoch to restore (default latest) —
+    with ``--keep-best`` retention the best checkpoint is often not the
+    newest, so offline eval must be able to target it."""
     import jax.numpy as jnp
     import optax
 
@@ -118,11 +122,17 @@ def load_state(model_name: str, workdir: str | None, sample, **model_kw):
 
         mgr = CheckpointManager(f"{workdir}/ckpt")
         if mgr.latest_epoch() is not None:
-            state, meta = mgr.restore_inference(state)
+            state, meta = mgr.restore_inference(state, epoch)
             print(f"restored epoch {meta['epoch']} from {workdir}/ckpt")
             mgr.close()
             return state
         mgr.close()
+    if epoch is not None:
+        # an EXPLICIT epoch request must not silently score random
+        # weights (near-zero metrics recorded as that epoch's result)
+        raise FileNotFoundError(
+            f"requested epoch {epoch} but no checkpoint dir under "
+            f"{workdir!r}")
     print("no checkpoint found — running freshly initialized weights")
     return state
 
