@@ -59,6 +59,7 @@ class Trainer:
         shard_weight_update: bool = False,
         async_checkpoint: bool = False,
         keep_best: bool = False,
+        data_echo: int = 1,
     ):
         self.model = model
         self.config = config
@@ -67,9 +68,16 @@ class Trainer:
         self.val_data = val_data
         self.workdir = Path(workdir) / config.get("name", "run")
         self.log_every = log_every
+        # data echoing (Choi et al. 2019): run `data_echo` optimizer
+        # steps per transferred batch (fresh dropout/augment PRNG each),
+        # multiplying effective step throughput when the host pipeline or
+        # H2D link — not the chip — is the bottleneck
+        self.data_echo = max(1, int(data_echo))
 
+        # step-count schedules see OPTIMIZER steps: with echoing each
+        # data epoch advances the counter data_echo * steps_per_epoch
         self.tx, self.plateau = make_optimizer(
-            config, steps_per_epoch or 1000
+            config, (steps_per_epoch or 1000) * self.data_echo
         )
         size = config.get("input_size", 224)
         sample = np.zeros(
@@ -152,6 +160,7 @@ class Trainer:
                 epoch, self.state, loggers=self.loggers,
                 extra={
                     "step_in_epoch": int(step_in_epoch),
+                    "data_echo": self.data_echo,
                     **({"plateau": self.plateau.state_dict()}
                        if self.plateau else {}),
                 },
@@ -186,6 +195,15 @@ class Trainer:
                 if p_epoch is not None and (latest is None
                                             or p_epoch > latest):
                     self.state, meta = pmgr.restore(self.state)
+                    saved_echo = meta["extra"].get("data_echo", 1)
+                    if saved_echo != self.data_echo:
+                        # the step index and PRNG replay are in units of
+                        # the saved echo factor — resuming under another
+                        # silently diverges from the uninterrupted run
+                        raise ValueError(
+                            f"preemption checkpoint was written with "
+                            f"--data-echo {saved_echo}; resume with the "
+                            f"same value (got {self.data_echo})")
                     self._apply_meta(meta)
                     self.start_epoch = meta["epoch"]  # redo this epoch...
                     self.start_step = meta["extra"]["step_in_epoch"]  # here
@@ -222,7 +240,9 @@ class Trainer:
         # order this makes resume-at-epoch-N bit-identical to an
         # uninterrupted run reaching epoch N (dropout masks, GAN noise)
         self._key = jax.random.fold_in(self._base_key, epoch)
-        for _ in range(start_step):  # replay the consumed chain positions
+        # replay the consumed chain positions (echo steps consume
+        # data_echo splits per batch)
+        for _ in range(start_step * self.data_echo):
             self._key, _ = jax.random.split(self._key)
         t0 = time.perf_counter()
         counts: list[int] = []
@@ -247,12 +267,15 @@ class Trainer:
         for i, device_batch in enumerate(
             device_prefetch(counted(), self.mesh)
         ):
-            self._key, sub = jax.random.split(self._key)
-            self.state, metrics = self._train_step(
-                self.state, device_batch, sub
-            )
-            pending.append(metrics)
+            for _ in range(self.data_echo):  # device-side batch reuse
+                self._key, sub = jax.random.split(self._key)
+                self.state, metrics = self._train_step(
+                    self.state, device_batch, sub
+                )
+                pending.append(metrics)
             if self._preempt:
+                # batch-granular: the resume point is a transferred-batch
+                # index, so a preemption mid-echo-group replays the group
                 drain()  # park the dispatch queue before serializing
                 self._save_preempt(epoch, start_step + i + 1)
                 self.preempted = True
@@ -270,8 +293,10 @@ class Trainer:
                 )
         drain()  # drains the dispatch queue — MUST precede the timing read
         dt = time.perf_counter() - t0
-        n_images = sum(counts)
-        w = np.asarray(counts, np.float64)
+        # throughput counts optimizer-processed samples; with echoing
+        # each transferred image is processed data_echo times
+        n_images = sum(counts) * self.data_echo
+        w = np.repeat(np.asarray(counts, np.float64), self.data_echo)
         # exact batch-size-weighted epoch aggregates
         agg = {
             k: float(np.average([m[k] for m in fetched], weights=w))
@@ -281,6 +306,8 @@ class Trainer:
         out = {
             f"train_{k}": v for k, v in agg.items()
         }  # loss + whatever the step emits (top1/top5, YOLO loss parts…)
+        if self.data_echo > 1:  # make echoed throughput attributable
+            out["data_echo"] = float(self.data_echo)
         out.update(
             examples_per_sec=n_images / dt,
             images_per_sec_per_chip=n_images / dt / n_chips,

@@ -83,6 +83,11 @@ def parse_args():
                         "metric instead of the most recent (the "
                         "reference's save-on-new-best, "
                         "ref: YOLO/tensorflow/train.py:243-257)")
+    p.add_argument("--data-echo", type=int, default=1,
+                   help="optimizer steps per transferred batch (data "
+                        "echoing, arXiv:1907.05550) — multiplies step "
+                        "throughput when the input pipeline or H2D "
+                        "link, not the chip, is the bottleneck")
     return p.parse_args()
 
 
@@ -313,7 +318,7 @@ def main():
         check_numerics=args.check_numerics,
         shard_weight_update=args.shard_weight_update,
         async_checkpoint=args.async_checkpoint,
-        keep_best=args.keep_best, **step_fns,
+        keep_best=args.keep_best, data_echo=args.data_echo, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
