@@ -83,6 +83,7 @@ _SLOW_TESTS = {
     "test_evaluate_detection_cli_runs",
     "test_evaluate_pose_cli_runs",
     "test_evaluate_gan_cyclegan_plumbing",
+    "test_evaluate_gan_dcgan_plumbing",
     "test_s2d_stem_matches_plain_conv_stem",
     # heavyweight model/infra tests (15-130s each)
     "test_centernet_output_shapes",

@@ -261,3 +261,33 @@ def test_evaluate_gan_cyclegan_plumbing(tmp_path):
     assert out["model"] == "cyclegan" and out["epoch"] == 0
     assert out["mse_baseline"] > 0
     assert out["score"] < 0.5, "untrained generator must not pass"
+
+
+def test_evaluate_gan_dcgan_plumbing(tmp_path):
+    """evaluate.py gan -m dcgan: restore -> judge-classifier IS scoring.
+    An untrained generator must score far below the real-sample IS
+    (score = IS_gen / IS_real well under 1)."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    import evaluate
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+
+    g = get_model("dcgan_generator")
+    d = get_model("dcgan_discriminator")
+    state = create_dcgan_state(g, d)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(0, state)
+    mgr.close()
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        evaluate.main(["gan", "-m", "dcgan",
+                       "--workdir", str(tmp_path), "--n", "64"])
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["model"] == "dcgan" and out["epoch"] == 0
+    # the judge itself must be competent, else the metric means nothing
+    assert out["judge_holdout_acc"] > 0.95
+    assert out["is_real"] > out["is_generated"]
+    assert out["score"] < 0.7, "untrained generator must not pass"
