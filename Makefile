@@ -68,12 +68,14 @@ convert:
 
 # synthetic task-metric gates: train to convergence on the hermetic
 # synthetic sets, then score with the real eval metrics (mAP / PCK).
-# Data sizes follow the measured r3/r4 scaling: 1024 imgs plateaued at
-# mAP 0.67, 2048 overfit (train 0.61 / val 4.32) at 0.856; the 4096
-# recipe reached 0.88 by epoch 24 with train~val (EVIDENCE.md r4)
+# Data sizes follow the measured r3/r4 scaling curve (mAP 0.67 @ 1024,
+# 0.856 @ 2048, 0.880 @ 4096, crossed 0.9 @ 8192+flip — EVIDENCE.md);
+# --keep-best retains the val-loss-ranked checkpoints so the peak epoch
+# can be scored with `evaluate.py --epoch` after the overfit knee
 gate_detection:
 	$(PY) train.py -m yolov3 --num-classes 5 --lr 1e-3 --batch-size 32 \
-		--epochs 50 --synthetic-size 4096 --workdir $(WORKDIR)/gates
+		--epochs 50 --synthetic-size 8192 --keep-best \
+		--workdir $(WORKDIR)/gates
 	$(PY) evaluate.py detection -m yolov3 --num-classes 5 \
 		--workdir $(WORKDIR)/gates/yolov3
 
