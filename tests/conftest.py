@@ -79,6 +79,7 @@ _SLOW_TESTS = {
     "test_centernet_train_step_learns",
     "test_cyclegan_train_step",
     "test_dcgan_train_step_updates_both_and_learns",
+    "test_dcgan_label_smoothing_changes_only_d_real_term",
     "test_centernet_sharded_step_smoke",
     "test_evaluate_detection_cli_runs",
     "test_evaluate_pose_cli_runs",

@@ -291,3 +291,35 @@ def test_evaluate_gan_dcgan_plumbing(tmp_path):
     assert out["judge_holdout_acc"] > 0.95
     assert out["is_real"] > out["is_generated"]
     assert out["score"] < 0.7, "untrained generator must not pass"
+
+
+def test_dcgan_label_smoothing_changes_only_d_real_term(mesh8):
+    """One-sided smoothing: real targets become 1-s for the
+    discriminator; the generator loss is untouched at identical
+    parameters."""
+    from functools import partial
+
+    from deepvision_tpu.core import shard_batch
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.train.gan import dcgan_train_step
+
+    g = get_model("dcgan_generator")
+    d = get_model("dcgan_discriminator")
+    state = create_dcgan_state(g, d)
+    imgs = np.random.default_rng(0).normal(
+        0, 0.5, (16, 28, 28, 1)).astype(np.float32)
+    batch = shard_batch(mesh8, {"image": imgs})
+    key = jax.random.key(0)
+
+    plain = compile_train_step(dcgan_train_step, mesh8,
+                               donate_state=False)
+    smooth = compile_train_step(
+        partial(dcgan_train_step, label_smooth=0.1), mesh8,
+        donate_state=False)
+    _, m_plain = plain(state, batch, key)
+    _, m_smooth = smooth(state, batch, key)
+    # same params + same PRNG: g_loss identical, d_loss differs
+    assert float(m_plain["g_loss"]) == pytest.approx(
+        float(m_smooth["g_loss"]), rel=1e-5)
+    assert float(m_plain["d_loss"]) != pytest.approx(
+        float(m_smooth["d_loss"]), rel=1e-3)

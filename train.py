@@ -83,6 +83,10 @@ def parse_args():
                         "metric instead of the most recent (the "
                         "reference's save-on-new-best, "
                         "ref: YOLO/tensorflow/train.py:243-257)")
+    p.add_argument("--label-smooth", type=float, default=0.0,
+                   help="one-sided label smoothing on the DCGAN "
+                        "discriminator's real targets (Salimans et al. "
+                        "2016); 0 = reference-parity plain BCE")
     p.add_argument("--data-echo", type=int, default=1,
                    help="optimizer steps per transferred batch (data "
                         "echoing, arXiv:1907.05550) — multiplies step "
@@ -130,6 +134,13 @@ def main():
             f"(this run: dataset={cfg['dataset']!r}, "
             f"data_dir={args.data_dir!r})"
         )
+    if args.label_smooth and cfg["dataset"] != "gan_mnist":
+        raise SystemExit(
+            "--label-smooth only applies to the DCGAN config "
+            f"(this run: {args.model!r})")
+    if not 0.0 <= args.label_smooth < 1.0:
+        raise SystemExit(
+            f"--label-smooth must be in [0, 1), got {args.label_smooth}")
     if cfg["dataset"].startswith("gan"):
         run_gan(args, cfg, dtype)
         return
@@ -418,6 +429,11 @@ def run_gan(args, cfg, dtype):
             lr=cfg["optimizer_params"]["lr"],
         )
         step_fn = dcgan_train_step
+        if args.label_smooth:
+            from functools import partial
+
+            step_fn = partial(dcgan_train_step,
+                              label_smooth=args.label_smooth)
     else:  # cyclegan
         size = cfg["input_size"]
         if args.data_dir:
