@@ -437,6 +437,7 @@ def fit_gan(
     shard_weight_update: bool = False,
     async_checkpoint: bool = False,
     preempt=None,
+    watchdog=None,
 ):
     """Minimal GAN epoch loop: compiled step + loggers + TB + Orbax saves
     every ``save_every`` epochs keeping 3 (ref: DCGAN/tensorflow/main.py:39,
@@ -447,7 +448,11 @@ def fit_gan(
     boundary; when truthy the loop saves off-cadence and stops (the GAN
     analog of Trainer's SIGTERM handling — epoch-granular because GAN
     epochs on the reference workloads are short; resume restarts at the
-    next epoch)."""
+    next epoch).
+
+    ``watchdog``: optional Trainer.StallWatchdog — started here, beaten
+    per step/drain, stopped on exit (same hang-detection contract as
+    Trainer.fit)."""
     from deepvision_tpu.core.step import (
         compile_checked_train_step,
         compile_train_step,
@@ -475,6 +480,8 @@ def fit_gan(
     )
     step = compiler(train_step, mesh, state_spec=state_spec)
     base_key = jax.random.key(np.uint32(1234))
+    if watchdog is not None:
+        watchdog.start()
     for epoch in range(start_epoch, epochs):
         # epoch-derived noise stream: resume reproduces the uninterrupted
         # run's z draws / pool coin flips (same rationale as Trainer)
@@ -488,9 +495,11 @@ def fit_gan(
         fetched: list[dict] = []  # host floats; each metric fetched ONCE
 
         def drain():
-            fetched.extend(
-                {k: float(v) for k, v in m.items()} for m in pending
-            )
+            # completed-step heartbeats, same rationale as Trainer
+            for m in pending:
+                fetched.append({k: float(v) for k, v in m.items()})
+                if watchdog is not None:
+                    watchdog.beat()
             pending.clear()
 
         for i, device_batch in enumerate(
@@ -499,6 +508,8 @@ def fit_gan(
             key, sub = jax.random.split(key)
             state, metrics = step(state, device_batch, sub)
             pending.append(metrics)
+            if watchdog is not None:
+                watchdog.beat()
             if log_every and i % log_every == 0:
                 drain()  # syncs mostly-finished work; O(n) fetches total
                 print(f"[epoch {epoch} batch {i}] " + " ".join(
@@ -525,4 +536,6 @@ def fit_gan(
             break
     tb.flush()
     mgr.close()
+    if watchdog is not None:
+        watchdog.stop()
     return state, loggers

@@ -17,8 +17,10 @@ class over the compiled step functions:
 
 from __future__ import annotations
 
+import os
 import shutil
 import signal
+import threading
 import time
 from pathlib import Path
 from typing import Callable, Iterable
@@ -60,6 +62,8 @@ class Trainer:
         async_checkpoint: bool = False,
         keep_best: bool = False,
         data_echo: int = 1,
+        stall_timeout: float | None = None,
+        stall_abort: bool = False,
     ):
         self.model = model
         self.config = config
@@ -125,6 +129,11 @@ class Trainer:
         # returns with .preempted set so the launcher can exit 143.
         self._preempt = False
         self.preempted = False
+        # hang detection (SURVEY §5.3): heartbeat per step/val batch
+        self._watchdog = (
+            StallWatchdog(stall_timeout, abort=stall_abort)
+            if stall_timeout else None
+        )
         # per-epoch stream derived in train_epoch: _key is only valid
         # inside an epoch
         self._base_key = jax.random.key(seed + 1)
@@ -250,9 +259,14 @@ class Trainer:
         fetched: list[dict] = []  # host floats; each metric fetched ONCE
 
         def drain():
-            fetched.extend(
-                {k: float(v) for k, v in m.items()} for m in pending
-            )
+            # each float() below is a COMPLETED device step — beat per
+            # fetch so a long epoch-end drain of the dispatch queue (or
+            # a blocking save) cannot trip the watchdog, and a wedged
+            # device is detected even while dispatches still enqueue
+            for m in pending:
+                fetched.append({k: float(v) for k, v in m.items()})
+                if self._watchdog:
+                    self._watchdog.beat()
             pending.clear()
 
         def counted():
@@ -273,6 +287,8 @@ class Trainer:
                     self.state, device_batch, sub
                 )
                 pending.append(metrics)
+                if self._watchdog:
+                    self._watchdog.beat()
             if self._preempt:
                 # batch-granular: the resume point is a transferred-batch
                 # index, so a preemption mid-echo-group replays the group
@@ -316,13 +332,27 @@ class Trainer:
         return out
 
     def validate(self) -> dict:
-        metrics, _ = aggregate_eval_parts(
-            self._eval_step(self.state, shard_batch(self.mesh, batch))
-            for batch in self.val_data()
-        )
+        def parts():
+            for batch in self.val_data():
+                out = self._eval_step(self.state,
+                                      shard_batch(self.mesh, batch))
+                if self._watchdog:
+                    self._watchdog.beat()
+                yield out
+
+        metrics, _ = aggregate_eval_parts(parts())
         return metrics
 
     def fit(self, epochs: int | None = None) -> Loggers:
+        if self._watchdog:
+            self._watchdog.start()
+        try:
+            return self._fit(epochs)
+        finally:
+            if self._watchdog:
+                self._watchdog.stop()
+
+    def _fit(self, epochs: int | None = None) -> Loggers:
         total = epochs or self.config.get("total_epochs", 1)
         if self.start_epoch == 0 and self.start_step == 0:
             val = self.validate()  # pre-train validation (ref: train.py:390)
@@ -402,6 +432,83 @@ class Trainer:
                 return self.loggers
         self.ckpt.wait_until_finished()  # commit any in-flight async save
         return self.loggers
+
+
+class StallWatchdog:
+    """Failure DETECTION for silent device hangs (SURVEY §5.3 — the
+    reference has none; its failure story is reading nohup logs).
+
+    A wedged runtime RPC blocks the step loop in a C call: no exception,
+    no log line, signal handlers can't run — the observed failure mode
+    on the relay-attached chip (EVIDENCE.md r4 YOLO gate). A daemon
+    thread watches a heartbeat the step loop touches after every step;
+    if none lands within ``timeout_s`` it prints a loud diagnosis, and
+    with ``abort=True`` exits the process with code 75 (EX_TEMPFAIL) so
+    a supervisor can restart into the bit-exact ``--resume`` path —
+    detection + recovery instead of a hang nobody notices.
+    """
+
+    def __init__(self, timeout_s: float, *, abort: bool = False,
+                 _exit=os._exit):
+        if timeout_s <= 0:
+            raise ValueError(f"stall timeout must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.abort = abort
+        self._exit = _exit  # injectable for tests
+        # ARMED ONLY AFTER THE FIRST BEAT: the first step call blocks on
+        # XLA compilation for minutes legitimately; a pre-armed watchdog
+        # would abort healthy cold starts into a supervisor restart loop.
+        # (Tradeoff: a wedge before any step ever completes goes
+        # undetected — acceptable, the operator sees a run that never
+        # logged a batch.)
+        self._last: float | None = None
+        self._stop = threading.Event()
+        self._fired = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        """Idempotent while running; re-entrant after stop() — fit() may
+        be called repeatedly on one Trainer."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._last = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last = time.monotonic()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+    def _run(self):
+        poll = min(self.timeout_s / 4.0, 5.0)
+        while not self._stop.wait(poll):
+            if self._last is None:
+                continue  # not armed until the first step lands
+            stalled = time.monotonic() - self._last
+            if stalled > self.timeout_s:
+                self._fired.set()
+                print(
+                    f"[stall] no heartbeat in {stalled:.0f}s "
+                    f"(timeout {self.timeout_s:.0f}s) — likely a wedged "
+                    "device/runtime RPC; the process "
+                    + ("will exit 75 for a supervised restart + --resume"
+                       if self.abort else
+                       "is left running (use --stall-abort to exit 75)"),
+                    flush=True,
+                )
+                if self.abort:
+                    self._exit(75)
+                self._last = time.monotonic()  # warn again, don't spam
 
 
 def make_preempt_flag(signals=(signal.SIGTERM,)) -> Callable[[], bool]:

@@ -93,6 +93,8 @@ _SLOW_TESTS = {
     "test_pool_matches_reference_semantics",
     "test_resume_reproduces_uninterrupted_run",
     "test_preempt_resume_is_bit_identical",
+    "test_trainer_heartbeats_keep_watchdog_quiet",
+    "test_gan_loop_beats_watchdog",
     "test_sigterm_subprocess_roundtrip",
     "test_cyclegan_models_shapes",
     "test_yolo_loss_three_scales_additive",

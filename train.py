@@ -83,6 +83,15 @@ def parse_args():
                         "metric instead of the most recent (the "
                         "reference's save-on-new-best, "
                         "ref: YOLO/tensorflow/train.py:243-257)")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="seconds without a completed step before the "
+                        "stall watchdog fires (0 = off) — detects "
+                        "wedged device/runtime RPCs that block the "
+                        "step loop in a C call")
+    p.add_argument("--stall-abort", action="store_true",
+                   help="on stall, exit 75 (EX_TEMPFAIL) so a "
+                        "supervisor restarts into --resume instead of "
+                        "hanging forever")
     p.add_argument("--label-smooth", type=float, default=0.0,
                    help="one-sided label smoothing on the DCGAN "
                         "discriminator's real targets (Salimans et al. "
@@ -141,6 +150,9 @@ def main():
     if not 0.0 <= args.label_smooth < 1.0:
         raise SystemExit(
             f"--label-smooth must be in [0, 1), got {args.label_smooth}")
+    if args.stall_timeout < 0:
+        raise SystemExit(
+            f"--stall-timeout must be >= 0, got {args.stall_timeout}")
     if cfg["dataset"].startswith("gan"):
         run_gan(args, cfg, dtype)
         return
@@ -329,7 +341,9 @@ def main():
         check_numerics=args.check_numerics,
         shard_weight_update=args.shard_weight_update,
         async_checkpoint=args.async_checkpoint,
-        keep_best=args.keep_best, data_echo=args.data_echo, **step_fns,
+        keep_best=args.keep_best, data_echo=args.data_echo,
+        stall_timeout=args.stall_timeout or None,
+        stall_abort=args.stall_abort, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
@@ -470,9 +484,14 @@ def run_gan(args, cfg, dtype):
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     # SIGTERM -> stop at the next epoch boundary with an off-cadence save
     # (same contract as Trainer.install_preemption_handler)
-    from deepvision_tpu.train.trainer import make_preempt_flag
+    from deepvision_tpu.train.trainer import (
+        StallWatchdog,
+        make_preempt_flag,
+    )
 
     preempted = make_preempt_flag()
+    watchdog = (StallWatchdog(args.stall_timeout, abort=args.stall_abort)
+                if args.stall_timeout else None)
     fit_gan(
         state, step_fn, train_data, mesh,
         epochs=epochs, workdir=workdir,
@@ -483,6 +502,7 @@ def run_gan(args, cfg, dtype):
         shard_weight_update=args.shard_weight_update,
         async_checkpoint=args.async_checkpoint,
         preempt=preempted,
+        watchdog=watchdog,
     )
     if preempted():
         raise SystemExit(143)
