@@ -83,10 +83,12 @@ gate_detection:
 # fires on this task (val micro-improves each epoch), so the CenterNet-
 # paper x10 lr drop is applied manually via resume
 gate_centernet:
-	$(PY) train.py -m centernet --num-classes 5 --epochs 50 \
-		--synthetic-size 1024 --workdir $(WORKDIR)/gates
+	$(PY) train.py -m centernet --num-classes 5 --epochs 50 --keep-best \
+		--synthetic-size 2048 --stall-timeout 420 \
+		--workdir $(WORKDIR)/gates
 	$(PY) train.py -m centernet --num-classes 5 --epochs 65 --lr 1e-4 \
-		--synthetic-size 1024 --workdir $(WORKDIR)/gates --resume
+		--synthetic-size 2048 --keep-best --stall-timeout 420 \
+		--workdir $(WORKDIR)/gates --resume
 	$(PY) evaluate.py detection -m centernet --num-classes 5 --size 128 \
 		--workdir $(WORKDIR)/gates/centernet
 
