@@ -457,7 +457,6 @@ def fit_gan(
         compile_checked_train_step,
         compile_train_step,
     )
-    from deepvision_tpu.data.device_put import device_prefetch
     from deepvision_tpu.train.checkpoint import CheckpointManager
     from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
 
@@ -482,6 +481,29 @@ def fit_gan(
     base_key = jax.random.key(np.uint32(1234))
     if watchdog is not None:
         watchdog.start()
+    try:
+        state, loggers = _gan_epoch_loop(
+            state, step, train_data, mesh, start_epoch, epochs,
+            base_key, mgr, loggers, tb, save_every, log_every,
+            preempt, watchdog,
+        )
+    finally:
+        # an exception mid-epoch must still stop the daemon watchdog
+        # (abort=True could otherwise os._exit(75) during unrelated
+        # exception handling, masking the real traceback) and close the
+        # manager so staged async saves commit or are cleanly dropped
+        tb.flush()
+        mgr.close()
+        if watchdog is not None:
+            watchdog.stop()
+    return state, loggers
+
+
+def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
+                    base_key, mgr, loggers, tb, save_every, log_every,
+                    preempt, watchdog):
+    from deepvision_tpu.data.device_put import device_prefetch
+
     for epoch in range(start_epoch, epochs):
         # epoch-derived noise stream: resume reproduces the uninterrupted
         # run's z draws / pool coin flips (same rationale as Trainer)
@@ -508,8 +530,12 @@ def fit_gan(
             key, sub = jax.random.split(key)
             state, metrics = step(state, device_batch, sub)
             pending.append(metrics)
-            if watchdog is not None:
-                watchdog.beat()
+            # beats land only in drain() (per COMPLETED step) — a
+            # dispatch-side beat would mask a wedged device until the
+            # dispatch queue itself blocked; cadence bounded at 32
+            # batches regardless of log_every (same fix as Trainer)
+            if watchdog is not None and i % min(32, log_every or 32) == 0:
+                drain()
             if log_every and i % log_every == 0:
                 drain()  # syncs mostly-finished work; O(n) fetches total
                 print(f"[epoch {epoch} batch {i}] " + " ".join(
@@ -534,8 +560,4 @@ def fit_gan(
         if stop:
             print(f"[preempted] after completed epoch {epoch}", flush=True)
             break
-    tb.flush()
-    mgr.close()
-    if watchdog is not None:
-        watchdog.stop()
     return state, loggers

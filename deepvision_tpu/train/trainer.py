@@ -287,8 +287,15 @@ class Trainer:
                     self.state, device_batch, sub
                 )
                 pending.append(metrics)
-                if self._watchdog:
-                    self._watchdog.beat()
+            # heartbeats land only in drain() (per COMPLETED step): a
+            # dispatch-side beat marks an ENQUEUED step, so a wedged
+            # device would keep "beating" until the dispatch queue
+            # blocked, stretching detection latency past the timeout.
+            # The watchdog forces its own drain cadence, bounded at 32
+            # batches regardless of log_every (log_every=500 would
+            # otherwise starve beats and false-trip healthy runs).
+            if self._watchdog and i % min(32, self.log_every or 32) == 0:
+                drain()
             if self._preempt:
                 # batch-granular: the resume point is a transferred-batch
                 # index, so a preemption mid-echo-group replays the group
@@ -390,7 +397,7 @@ class Trainer:
             metric = val.get(
                 "val_top1",
                 -val["val_loss"] if "val_loss" in val
-                else (tr["train_loss"] if not start_step else None),
+                else (-tr["train_loss"] if not start_step else None),
             )
             if metric is not None:
                 if self.plateau is not None:
@@ -473,6 +480,9 @@ class StallWatchdog:
             return self
         self._last = None
         self._stop = threading.Event()
+        # fresh fired-state per run: a stale fired=True from a previous
+        # non-abort stall would mislabel every later healthy fit()
+        self._fired = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         return self

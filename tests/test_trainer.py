@@ -196,3 +196,28 @@ def test_keep_best_retention(tmp_path):
     # best two are epochs 1 (0.9) and 2 (0.7)
     assert mgr.saved_epochs() == [1, 2]
     mgr.close()
+
+
+def test_no_val_plateau_metric_is_negated_train_loss(tmp_path, mesh8):
+    """Validation-less runs plateau on -train_loss: LOWER loss must rank
+    BETTER under the mode='max' controller and --keep-best retention.
+    (Regression: the fallback briefly lost its negation, making the
+    worst epochs rank as best.)"""
+    imgs, labels = synthetic_mnist(256)
+    cfg = get_config("lenet5")
+    cfg["batch_size"] = 64
+    rng = np.random.default_rng(0)
+    t = Trainer(
+        get_model("lenet5"), cfg, mesh8,
+        lambda e: batches(imgs, labels, 64, rng=rng),
+        lambda: iter(()),  # no validation data at all
+        workdir=tmp_path, steps_per_epoch=4, log_every=0,
+    )
+    loggers = t.fit(3)
+    losses = loggers.data["train_loss"]["value"]
+    assert len(losses) == 3
+    # best_metric must equal the max of the NEGATED losses: the epoch
+    # with the lowest train loss is the best one
+    assert t.best_metric == pytest.approx(max(-l for l in losses))
+    assert t.best_metric == pytest.approx(-min(losses))
+    t.ckpt.close()

@@ -102,6 +102,25 @@ def test_watchdog_restartable_after_stop():
     assert not wd._thread.is_alive()
 
 
+def test_watchdog_fired_resets_on_restart():
+    """A non-abort stall in one run must not label every later run on
+    the same Trainer as fired: start() clears the fired state."""
+    wd = StallWatchdog(0.3, abort=False).start()
+    try:
+        wd.beat()
+        time.sleep(0.8)
+        assert wd.fired
+    finally:
+        wd.stop()
+    wd.start()  # second fit() on the same Trainer
+    try:
+        assert not wd.fired  # stale fired state cleared
+        wd.beat()
+        assert not wd.fired
+    finally:
+        wd.stop()
+
+
 def test_gan_loop_beats_watchdog(tmp_path, mesh8):
     """fit_gan drives the same watchdog contract (start/beat/stop)."""
     from deepvision_tpu.data.mnist import synthetic_mnist
