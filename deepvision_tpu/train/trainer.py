@@ -17,6 +17,7 @@ class over the compiled step functions:
 
 from __future__ import annotations
 
+import fcntl
 import os
 import shutil
 import signal
@@ -40,6 +41,72 @@ from deepvision_tpu.train.steps import (
     classification_eval_step,
     classification_train_step,
 )
+
+
+class PreemptLock:
+    """Advisory cross-process mutex (``fcntl.flock``) serializing the
+    preemption-checkpoint protocol.
+
+    Root cause of the r4 field crash (logs/gate_yolo_r4c.log:866-910):
+    a relaunched ``--resume`` process's stale-cleanup ``rmtree`` of
+    ``ckpt_preempt/`` ran while the dying process was still inside
+    Orbax finalize, deleting the ``*.orbax-checkpoint-tmp`` staging dir
+    out from under the atomic rename (``FileNotFoundError: ...
+    meta.orbax-checkpoint-tmp -> meta``). Nothing serialized the three
+    parties that touch the directory: the dying writer
+    (``_save_preempt``), a concurrent resumer (``resume``'s inspect /
+    restore / stale-clear), and the epoch-supersede clear in ``fit``.
+
+    All three now run under this lock. ``flock`` conflicts between
+    separate open file descriptions, so it excludes both other
+    processes and other Trainer instances in-process (threads).
+    Acquisition is bounded: a waiter that times out proceeds WITHOUT
+    touching the preemption directory (a wedged lock holder must not
+    block recovery forever; skipping the clear is always safe because
+    resume ignores preemption saves older than the latest epoch
+    checkpoint).
+    """
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._fd: int | None = None
+
+    def acquire(self, timeout: float | None = None) -> bool:
+        """True once the exclusive lock is held; False on timeout, or
+        immediately on a filesystem that cannot flock at all
+        (ENOTSUP/ENOLCK — gcsfuse, NFS without lockd): fail fast into
+        the callers' degraded paths instead of spinning the full
+        timeout on every acquisition."""
+        import errno
+
+        contention = {errno.EWOULDBLOCK, errno.EAGAIN, errno.EACCES,
+                      errno.EINTR}
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fd = fd
+                return True
+            except OSError as e:
+                if e.errno not in contention:
+                    os.close(fd)
+                    print(f"[preempt-lock] {self.path}: flock unsupported "
+                          f"({e}); proceeding without cross-process "
+                          "locking", flush=True)
+                    return False
+                if deadline is not None and time.monotonic() >= deadline:
+                    os.close(fd)
+                    return False
+                time.sleep(0.05)
+
+    def release(self) -> None:
+        if self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
 
 
 class Trainer:
@@ -129,6 +196,11 @@ class Trainer:
         # returns with .preempted set so the launcher can exit 143.
         self._preempt = False
         self.preempted = False
+        # serializes save / resume-inspect / stale-clear of ckpt_preempt/
+        # across processes (see PreemptLock). The lock file lives BESIDE
+        # the directory so clearing the directory can't delete the lock.
+        self._plock = PreemptLock(self.workdir / "ckpt_preempt.lock")
+        self.preempt_lock_timeout = 300.0  # bounded wait; see PreemptLock
         # hang detection (SURVEY §5.3): heartbeat per step/val batch
         self._watchdog = (
             StallWatchdog(stall_timeout, abort=stall_abort)
@@ -142,6 +214,16 @@ class Trainer:
     @property
     def _preempt_dir(self) -> Path:
         return self.workdir / "ckpt_preempt"
+
+    @property
+    def _preempt_unlocked_dir(self) -> Path:
+        # escape-hatch target for a save whose PreemptLock acquisition
+        # timed out: writing (and pre-clearing) a SEPARATE directory
+        # means the unlocked path can never rmtree data the wedged lock
+        # holder is still reading/writing in ckpt_preempt/ — the exact
+        # class of race the lock exists to prevent. Only timed-out
+        # writers ever write here; resume() scans both directories.
+        return self.workdir / "ckpt_preempt_unlocked"
 
     def request_preempt(self, signum=None, frame=None) -> None:
         """Async-signal-safe: only flips a flag; the step loop performs
@@ -161,25 +243,46 @@ class Trainer:
         # a metric it doesn't have) and must be committed before exit.
         # Always start fresh: a second preemption of the SAME epoch
         # (resume -> preempted again) would otherwise hit Orbax's
-        # step-already-exists error
-        self._clear_preempt_ckpt()
-        mgr = CheckpointManager(self._preempt_dir, max_to_keep=1)
+        # step-already-exists error.
+        # The whole clear+save runs under the cross-process PreemptLock:
+        # a concurrently relaunched --resume process must not rmtree the
+        # in-flight Orbax staging dir mid-finalize (the r4 field crash).
+        # On lock timeout save anyway — a best-effort save under a
+        # wedged lock holder beats losing the mid-epoch state — but into
+        # the SEPARATE ckpt_preempt_unlocked/ directory, so the unlocked
+        # path never deletes data the wedged holder may be touching.
+        got = self._plock.acquire(timeout=self.preempt_lock_timeout)
+        target = self._preempt_dir
+        if not got:
+            target = self._preempt_unlocked_dir
+            print("[preempted] WARNING: preemption lock not acquired in "
+                  f"{self.preempt_lock_timeout:.0f}s; saving unlocked "
+                  f"to {target}", flush=True)
         try:
-            mgr.save(
-                epoch, self.state, loggers=self.loggers,
-                extra={
-                    "step_in_epoch": int(step_in_epoch),
-                    "data_echo": self.data_echo,
-                    **({"plateau": self.plateau.state_dict()}
-                       if self.plateau else {}),
-                },
-                best_metric=self.best_metric,
-            )
+            delay = float(os.environ.get("DVTPU_PREEMPT_SAVE_DELAY", "0"))
+            if delay:  # test hook: widen the locked critical section
+                time.sleep(delay)
+            shutil.rmtree(target, ignore_errors=True)
+            mgr = CheckpointManager(target, max_to_keep=1)
+            try:
+                mgr.save(
+                    epoch, self.state, loggers=self.loggers,
+                    extra={
+                        "step_in_epoch": int(step_in_epoch),
+                        "data_echo": self.data_echo,
+                        **({"plateau": self.plateau.state_dict()}
+                           if self.plateau else {}),
+                    },
+                    best_metric=self.best_metric,
+                )
+            finally:
+                mgr.close()
         finally:
-            mgr.close()
+            if got:
+                self._plock.release()
         self.ckpt.wait_until_finished()  # commit in-flight async saves too
         print(f"[preempted] saved epoch {epoch} step {step_in_epoch} "
-              f"to {self._preempt_dir}", flush=True)
+              f"to {target}", flush=True)
 
     def _clear_preempt_ckpt(self) -> None:
         if self._preempt_dir.exists():
@@ -195,35 +298,108 @@ class Trainer:
         path) newer than the latest epoch checkpoint takes precedence and
         resumes MID-epoch at its recorded step, bit-identical to the
         uninterrupted run (epoch-seeded data order + replayed PRNG chain).
+
+        The whole inspect / restore / stale-clear runs under the
+        cross-process PreemptLock: it both WAITS for a dying process's
+        in-flight preemption save (then resumes from it, instead of
+        missing the newest state) and guarantees the stale-clear rmtree
+        can never delete that save's Orbax staging dir mid-finalize
+        (the r4 field crash). If the lock cannot be acquired in
+        ``preempt_lock_timeout`` the resume degrades to READ-ONLY: it
+        restores the newest finalized preemption save if one exists
+        (without clearing anything — never deleting data a wedged
+        holder may be touching), else falls back to the latest epoch
+        checkpoint, else raises with an actionable message so a
+        supervisor's relaunch loop effectively polls the lock.
         """
-        if epoch is None and self._preempt_dir.exists():
-            pmgr = CheckpointManager(self._preempt_dir, max_to_keep=1)
-            try:
-                p_epoch = pmgr.latest_epoch()
-                latest = self.ckpt.latest_epoch()
-                if p_epoch is not None and (latest is None
-                                            or p_epoch > latest):
-                    self.state, meta = pmgr.restore(self.state)
-                    saved_echo = meta["extra"].get("data_echo", 1)
-                    if saved_echo != self.data_echo:
-                        # the step index and PRNG replay are in units of
-                        # the saved echo factor — resuming under another
-                        # silently diverges from the uninterrupted run
-                        raise ValueError(
-                            f"preemption checkpoint was written with "
-                            f"--data-echo {saved_echo}; resume with the "
-                            f"same value (got {self.data_echo})")
-                    self._apply_meta(meta)
-                    self.start_epoch = meta["epoch"]  # redo this epoch...
-                    self.start_step = meta["extra"]["step_in_epoch"]  # here
+        if epoch is None:
+            got = self._plock.acquire(timeout=self.preempt_lock_timeout)
+            if got:
+                try:
+                    if self._resume_from_preempt():
+                        return
+                finally:
+                    self._plock.release()
+            else:
+                print("[resume] WARNING: preemption lock not acquired in "
+                      f"{self.preempt_lock_timeout:.0f}s; read-only "
+                      "preemption scan, nothing will be cleared",
+                      flush=True)
+                if self._resume_from_preempt(allow_clear=False):
                     return
-            finally:
-                pmgr.close()
-            self._clear_preempt_ckpt()  # stale (older than an epoch save)
+                if self.ckpt.latest_epoch() is None:
+                    raise RuntimeError(
+                        "resume blocked: the preemption lock "
+                        f"{self._plock.path} is held (a dying process "
+                        "may still be saving), no finalized preemption "
+                        "checkpoint is visible yet, and no epoch "
+                        "checkpoint exists to fall back to — retry "
+                        "once the in-flight save lands")
         self.state, meta = self.ckpt.restore(self.state, epoch)
         self._apply_meta(meta)
         self.start_epoch = meta["epoch"] + 1
         self.start_step = 0
+
+    def _resume_from_preempt(self, allow_clear: bool = True) -> bool:
+        """Restore the newest mid-epoch preemption checkpoint (from
+        ``ckpt_preempt/`` or the unlocked escape-hatch directory) if it
+        is newer than the latest epoch checkpoint (True), else report
+        False. With ``allow_clear`` (held PreemptLock) stale
+        directories are garbage-collected; read-only callers (lock
+        timeout) never delete anything."""
+        latest = self.ckpt.latest_epoch()
+        best = None  # (epoch, step_in_epoch, dir)
+        for d in (self._preempt_dir, self._preempt_unlocked_dir):
+            if not d.exists():
+                continue
+            pmgr = CheckpointManager(d, max_to_keep=1)
+            try:
+                p_epoch = pmgr.latest_epoch()
+                if p_epoch is None or (latest is not None
+                                       and p_epoch <= latest):
+                    # stale (superseded by an epoch save) or no
+                    # finalized step (crashed/in-flight save leftovers)
+                    if allow_clear and not (
+                        d == self._preempt_unlocked_dir
+                        and p_epoch is None
+                    ):
+                        # never clear a step-less unlocked dir even
+                        # under the lock: its writer is by definition
+                        # NOT a lock holder, so an in-flight unlocked
+                        # save is indistinguishable from garbage
+                        shutil.rmtree(d, ignore_errors=True)
+                    continue
+                # rank candidates by (epoch, step_in_epoch): with both
+                # a locked and an unlocked save present, the furthest
+                # training point wins
+                meta = pmgr.restore_meta(p_epoch)
+                cand = (p_epoch, int(meta["extra"].get("step_in_epoch",
+                                                       0)), d)
+                if best is None or cand[:2] > best[:2]:
+                    best = cand
+            finally:
+                pmgr.close()
+        if best is None:
+            return False
+        p_epoch, _, d = best
+        pmgr = CheckpointManager(d, max_to_keep=1)
+        try:
+            self.state, meta = pmgr.restore(self.state, p_epoch)
+        finally:
+            pmgr.close()
+        saved_echo = meta["extra"].get("data_echo", 1)
+        if saved_echo != self.data_echo:
+            # the step index and PRNG replay are in units of
+            # the saved echo factor — resuming under another
+            # silently diverges from the uninterrupted run
+            raise ValueError(
+                f"preemption checkpoint was written with "
+                f"--data-echo {saved_echo}; resume with the "
+                f"same value (got {self.data_echo})")
+        self._apply_meta(meta)
+        self.start_epoch = meta["epoch"]  # redo this epoch...
+        self.start_step = meta["extra"]["step_in_epoch"]  # here
+        return True
 
     def _apply_meta(self, meta: dict) -> None:
         if meta.get("loggers"):
@@ -427,10 +603,17 @@ class Trainer:
             # staged when save() returns, and deleting the preemption
             # checkpoint before the commit would leave a kill window with
             # no recent checkpoint at all. (The wait only triggers on the
-            # first epoch after a preemption resume.)
+            # first epoch after a preemption resume.) The clear runs under
+            # the PreemptLock so it can never rmtree another process's
+            # in-flight save; on timeout the stale dir is simply left
+            # (resume ignores preemption saves older than an epoch save).
             if self._preempt_dir.exists():
                 self.ckpt.wait_until_finished()
-                self._clear_preempt_ckpt()
+                if self._plock.acquire(timeout=60.0):
+                    try:
+                        self._clear_preempt_ckpt()
+                    finally:
+                        self._plock.release()
             if self._preempt:  # signal arrived during validate/save: the
                 self.preempted = True  # epoch is fully committed — stop
                 self.ckpt.wait_until_finished()

@@ -12,6 +12,7 @@ Two layers:
    mid-epoch point.
 """
 
+import os
 import re
 import signal
 import subprocess
@@ -129,6 +130,174 @@ def test_preempt_during_validate_stops_after_epoch(tmp_path, mesh8):
     assert not (tmp_path / "c" / "lenet5" / "ckpt_preempt").exists()
     assert t.ckpt.latest_epoch() == 0  # only epoch 0 ran
     t.ckpt.close()
+
+
+def test_resume_waits_for_inflight_preempt_save(tmp_path, mesh8,
+                                                monkeypatch):
+    """The r4 field crash (logs/gate_yolo_r4c.log:866-910): a concurrent
+    --resume process raced the dying process's in-flight preemption
+    save. Under the PreemptLock the resumer must WAIT for the save and
+    then pick it up mid-epoch — not crash either process."""
+    import threading
+
+    from deepvision_tpu.data.mnist import synthetic_mnist
+    from deepvision_tpu.train.trainer import PreemptLock
+
+    imgs, labels = synthetic_mnist(64)
+    # widen the locked critical section so the resumer reliably arrives
+    # while the save is in flight
+    monkeypatch.setenv("DVTPU_PREEMPT_SAVE_DELAY", "4.0")
+
+    t1 = _make_trainer(tmp_path / "d", mesh8, imgs, labels,
+                       preempt_after=2)
+    # build the resumer BEFORE the save starts: its construction cost
+    # must not eat the save-delay window the race depends on
+    t2 = _make_trainer(tmp_path / "d", mesh8, imgs, labels)
+    errors = []
+
+    def run_a():
+        try:
+            t1.fit(2)
+        except Exception as e:  # the field crash surfaced here
+            errors.append(e)
+
+    a = threading.Thread(target=run_a)
+    a.start()
+    # wait until the dying "process" actually holds the lock
+    probe = PreemptLock(tmp_path / "d" / "lenet5" / "ckpt_preempt.lock")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if probe.acquire(timeout=0.01):
+            probe.release()
+            time.sleep(0.05)
+        else:
+            break  # held by the saver
+    else:
+        pytest.fail("saver never acquired the preemption lock")
+
+    # concurrent resumer: must block on the lock, then restore the
+    # mid-epoch checkpoint the saver was still writing. Re-check the
+    # lock is STILL held right before resuming — otherwise the test
+    # can pass without exercising the wait path at all.
+    assert not probe.acquire(timeout=0.01), (
+        "save window closed before resume; race not exercised")
+    t2.resume()
+    a.join(timeout=120)
+    assert not errors, errors  # the dying process's save must not crash
+    assert t1.preempted
+    assert t2.start_epoch == 0 and t2.start_step > 0  # picked up the save
+    t1.ckpt.close()
+    t2.ckpt.close()
+
+
+def test_resume_timeout_never_deletes_inflight_tmp(tmp_path, mesh8):
+    """While a (possibly wedged) writer holds the PreemptLock, resume()
+    must leave ckpt_preempt/ untouched — the stale-clear rmtree deleting
+    an in-flight *.orbax-checkpoint-tmp dir was the exact r4 failure —
+    and fall back to the latest epoch checkpoint. Once the lock is
+    free, a genuinely stale preemption dir is still cleared."""
+    from deepvision_tpu.data.mnist import synthetic_mnist
+    from deepvision_tpu.train.trainer import PreemptLock
+
+    imgs, labels = synthetic_mnist(64)
+    t1 = _make_trainer(tmp_path / "e", mesh8, imgs, labels)
+    t1.fit(1)  # epoch-0 checkpoint to fall back to
+    t1.ckpt.close()
+
+    run = tmp_path / "e" / "lenet5"
+    tmp_ckpt = run / "ckpt_preempt" / "5.orbax-checkpoint-tmp"
+    tmp_ckpt.mkdir(parents=True)
+    (tmp_ckpt / "payload").write_text("in-flight")
+
+    holder = PreemptLock(run / "ckpt_preempt.lock")
+    assert holder.acquire(timeout=1.0)
+    try:
+        t2 = _make_trainer(tmp_path / "e", mesh8, imgs, labels)
+        t2.preempt_lock_timeout = 0.3
+        t2.resume()  # old code: rmtree'd the tmp dir here
+        assert t2.start_epoch == 1 and t2.start_step == 0
+        assert (tmp_ckpt / "payload").exists(), (
+            "resume deleted another process's in-flight staging dir")
+        t2.ckpt.close()
+    finally:
+        holder.release()
+
+    # lock free + tmp dir older than the epoch checkpoint = stale:
+    # the normal cleanup path must still collect it
+    t3 = _make_trainer(tmp_path / "e", mesh8, imgs, labels)
+    t3.resume()
+    assert t3.start_epoch == 1
+    assert not (run / "ckpt_preempt").exists()
+    t3.ckpt.close()
+
+
+def test_unlocked_save_escape_hatch(tmp_path, mesh8):
+    """A writer whose lock acquisition times out must still save — but
+    into ckpt_preempt_unlocked/, never touching the lock holder's
+    directory — and a later resume must pick that save up."""
+    from deepvision_tpu.data.mnist import synthetic_mnist
+    from deepvision_tpu.train.trainer import PreemptLock
+
+    imgs, labels = synthetic_mnist(64)
+    run = tmp_path / "f" / "lenet5"
+    holder = PreemptLock(run / "ckpt_preempt.lock")
+    assert holder.acquire(timeout=1.0)
+    try:
+        t1 = _make_trainer(tmp_path / "f", mesh8, imgs, labels,
+                           preempt_after=2)
+        t1.preempt_lock_timeout = 0.3
+        t1.fit(2)
+        assert t1.preempted
+        assert (run / "ckpt_preempt_unlocked").exists()
+        assert not (run / "ckpt_preempt").exists()  # holder's dir untouched
+        t1.ckpt.close()
+    finally:
+        holder.release()
+
+    t2 = _make_trainer(tmp_path / "f", mesh8, imgs, labels)
+    t2.resume()
+    assert t2.start_epoch == 0 and t2.start_step > 0
+    t2.ckpt.close()
+
+
+def test_sigterm_with_concurrent_resume_subprocess(tmp_path):
+    """End-to-end replay of the r4 field sequence: SIGTERM a real
+    train.py, immediately launch a second process with --resume while
+    the first is still saving. The dying process must finish its save
+    cleanly (exit 143, no traceback) and the resumer must wait and
+    continue from the mid-epoch point."""
+    env = dict(os.environ, DVTPU_PREEMPT_SAVE_DELAY="30")
+    cmd = [
+        sys.executable, "-u", "train.py", "-m", "lenet5",
+        "--platform", "cpu", "--synthetic-size", "4096",
+        "--batch-size", "32", "--epochs", "2", "--workdir", str(tmp_path),
+    ]
+    a = subprocess.Popen(cmd, cwd=REPO, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    lines = []
+    deadline = time.time() + 300
+    for line in a.stdout:
+        lines.append(line)
+        if re.search(r"\[epoch 0 batch [1-9]", line):
+            a.send_signal(signal.SIGTERM)
+            break
+        assert time.time() < deadline, "".join(lines)
+    # launch the resumer NOW — the dying process holds the lock for
+    # ~30s, so the resumer's startup lands inside the save window
+    b = subprocess.Popen(cmd + ["--resume"], cwd=REPO,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    rest, _ = a.communicate(timeout=300)
+    out_a = "".join(lines) + rest
+    assert a.returncode == 143, out_a
+    assert "[preempted] saved epoch 0 step" in out_a, out_a
+    assert "Traceback" not in out_a, out_a  # the r4 crash signature
+    out_b, _ = b.communicate(timeout=600)
+    assert b.returncode == 0, out_b
+    assert "Traceback" not in out_b, out_b
+    m = re.search(r"resumed at epoch 0 step (\d+)", out_b)
+    assert m and int(m.group(1)) > 0, out_b
+    assert "[epoch 1]" in out_b  # ran to completion
 
 
 def test_sigterm_subprocess_roundtrip(tmp_path):
