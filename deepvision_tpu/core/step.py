@@ -71,12 +71,30 @@ def compile_train_step(
     key_sh = NamedSharding(mesh, P())
 
     return jax.jit(
-        step_fn,
+        _in_spatial_scope(step_fn, mesh),
         in_shardings=(state_sh, batch_sh, key_sh),
         out_shardings=(state_sh, NamedSharding(mesh, P())),
         donate_argnums=(0,) if donate_state else (),
         compiler_options=compiler_options(),
     )
+
+
+def _in_spatial_scope(step_fn, mesh: Mesh):
+    """Expose ``mesh`` to the thin-H spatial guard
+    (parallel/constraint.guard_thin_h) while ``step_fn`` TRACES. The
+    scope is a plain thread-local set around the Python body, so it
+    runs during tracing only — execution-time jit behavior (argument
+    resharding of restored checkpoints, donation) is untouched."""
+    import functools
+
+    from deepvision_tpu.parallel.constraint import spatial_mesh_scope
+
+    @functools.wraps(step_fn)
+    def scoped(*args):
+        with spatial_mesh_scope(mesh):
+            return step_fn(*args)
+
+    return scoped
 
 
 def _state_shardings(mesh: Mesh, state_spec):
@@ -100,7 +118,7 @@ def compile_eval_step(step_fn, mesh: Mesh, *, batch_spec: P | None = None,
     if batch_spec is None:
         batch_spec = P(AXIS_DATA)
     return jax.jit(
-        step_fn,
+        _in_spatial_scope(step_fn, mesh),
         in_shardings=(
             _state_shardings(mesh, state_spec),
             NamedSharding(mesh, batch_spec),
@@ -129,7 +147,8 @@ def compile_checked_train_step(
     """
     from jax.experimental import checkify as ck
 
-    checked = ck.checkify(step_fn, errors=ck.float_checks)
+    checked = ck.checkify(_in_spatial_scope(step_fn, mesh),
+                          errors=ck.float_checks)
     batch_spec = batch_spec if batch_spec is not None else P(AXIS_DATA)
     state_sh = _state_shardings(mesh, state_spec)
     # out structure is (error, (state, metrics)) — shardings inferred;

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from deepvision_tpu.models.layers import he_normal, max_pool
 from deepvision_tpu.models.registry import register
+from deepvision_tpu.parallel.constraint import guard_thin_h
 
 Dtype = Any
 
@@ -59,8 +60,11 @@ class PreActBottleneck(nn.Module):
                                name="proj")(x)
 
         def bn(x, name):
+            # f32 is a precision FLOOR (the r4 bf16-cripples-hourglass
+            # finding), not a ceiling: f64 runs keep f64
             return nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                dtype=jnp.float32, name=name)(x)
+                                dtype=jnp.promote_types(d, jnp.float32),
+                                name=name)(x)
 
         y = nn.relu(bn(x, "bn1"))
         y = nn.Conv(f // 2, (1, 1), use_bias=True, kernel_init=he_normal,
@@ -95,8 +99,11 @@ class HourglassModule(nn.Module):
         up = PreActBottleneck(f, dtype=d, name="up0")(x, train)
         for i in range(r):
             up = PreActBottleneck(f, dtype=d, name=f"up{i + 1}")(up, train)
-        # Lower branch.
-        low = max_pool(x)
+        # Lower branch. Under spatial partitioning the recursion pools
+        # H down to single rows; drop the H sharding once shards thin
+        # below the safe bound (parallel/constraint.py — the XLA SPMD
+        # thin-shard backward bug; no-op outside a spatial mesh).
+        low = guard_thin_h(max_pool(x))
         for i in range(r):
             low = PreActBottleneck(f, dtype=d, name=f"low1_{i}")(low, train)
         if self.order > 1:
@@ -129,9 +136,11 @@ class StackedHourglass(nn.Module):
     def __call__(self, x, train: bool = False):
         f, d = self.features, self.dtype
 
+        hd = jnp.promote_types(d, jnp.float32)  # f32 floor, not ceiling
+
         def bn(x, name):
             return nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                dtype=jnp.float32, name=name)(x)
+                                dtype=hd, name=name)(x)
 
         # Stem: 7x7/2 → bottleneck(128, proj) → pool → ×2 bottleneck → 256.
         # (ref: hourglass104.py:121-133; 256² → 64²)
@@ -157,8 +166,8 @@ class StackedHourglass(nn.Module):
                         dtype=d, name=f"linear{s}_conv")(y)
             y = nn.relu(bn(y, f"linear{s}_bn"))
             heat = nn.Conv(self.num_heatmaps, (1, 1), use_bias=True,
-                           kernel_init=he_normal, dtype=jnp.float32,
-                           name=f"head{s}")(y.astype(jnp.float32))
+                           kernel_init=he_normal, dtype=hd,
+                           name=f"head{s}")(y.astype(hd))
             outputs.append(heat)
             if s < self.num_stacks - 1:  # the ref's shadowed-index fix
                 # Paper/hg.lua re-injection is a 3-term sum (previous stack

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from deepvision_tpu.models.layers import ConvBN, global_avg_pool
 from deepvision_tpu.models.registry import register
+from deepvision_tpu.parallel.constraint import guard_thin_h
 
 Dtype = Any
 
@@ -70,6 +71,11 @@ class Darknet53(nn.Module):
                 features, (3, 3), strides=(2, 2), act=leaky,
                 dtype=self.dtype, name=f"down{stage}",
             )(x, train)
+            # under spatial partitioning, drop the H sharding once this
+            # stage's map is too thin — XLA SPMD miscomputes the
+            # strided-conv + residual backward at 1-row H shards
+            # (parallel/constraint.py; no-op outside a spatial mesh)
+            x = guard_thin_h(x)
             for b in range(blocks):
                 x = DarknetBlock(
                     features, dtype=self.dtype, name=f"stage{stage}_block{b}"
@@ -149,13 +155,19 @@ class YoloV3(nn.Module):
         x = ConvBN(256, (1, 1), act=leaky, dtype=d, name="lateral_medium")(
             branch, train
         )
-        x = jnp.concatenate([_upsample2x(x), feat_m], axis=-1)
+        # thin-H spatial guards on the merge points: the FPN's
+        # upsample+concat graph miscomputes backward under thin H
+        # shards even at widths where plain chains are exact
+        # (parallel/constraint.py; no-ops outside a spatial mesh)
+        x = guard_thin_h(jnp.concatenate([_upsample2x(x), feat_m],
+                                         axis=-1))
         branch, y_medium = _HeadBlock(256, out_ch, dtype=d,
                                       name="head_medium")(x, train)
         x = ConvBN(128, (1, 1), act=leaky, dtype=d, name="lateral_small")(
             branch, train
         )
-        x = jnp.concatenate([_upsample2x(x), feat_s], axis=-1)
+        x = guard_thin_h(jnp.concatenate([_upsample2x(x), feat_s],
+                                         axis=-1))
         _, y_small = _HeadBlock(128, out_ch, dtype=d, name="head_small")(
             x, train
         )

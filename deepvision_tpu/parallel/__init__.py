@@ -8,9 +8,14 @@ convolutions (the CNN analog of ring attention's neighbor exchange over
 ICI; SURVEY §5.7), written with ``jax.shard_map`` + ``lax.ppermute``.
 """
 
+from deepvision_tpu.parallel.constraint import (
+    guard_thin_h,
+    spatial_model_shards,
+)
 from deepvision_tpu.parallel.spatial import (
     halo_exchange,
     spatial_conv2d,
 )
 
-__all__ = ["halo_exchange", "spatial_conv2d"]
+__all__ = ["guard_thin_h", "halo_exchange", "spatial_conv2d",
+           "spatial_model_shards"]
