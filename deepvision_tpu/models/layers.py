@@ -14,6 +14,7 @@ from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 Dtype = Any
 
@@ -59,6 +60,12 @@ class ConvBN(nn.Module):
             dtype=self.dtype,
             name="conv",
         )(x)
+        # named for remat policies (ResNet.remat="conv"): lets backward
+        # keep only conv outputs and recompute the cheap BN/ReLU
+        # elementwise chain fused into its consumers, instead of
+        # re-reading separately saved post-BN activations from HBM.
+        # A plain no-op identity outside any remat scope.
+        x = checkpoint_name(x, "conv_out")
         x = nn.BatchNorm(
             use_running_average=not train,
             momentum=self.bn_momentum,
