@@ -162,6 +162,7 @@ class Trainer:
             from deepvision_tpu.core.step import weight_update_sharding
 
             state_spec = weight_update_sharding(self.state, mesh)
+        self._state_spec = state_spec
         if check_numerics:  # NaN/Inf tripwire (SURVEY §5.2)
             from deepvision_tpu.core.step import compile_checked_train_step
 
@@ -336,9 +337,28 @@ class Trainer:
                         "checkpoint exists to fall back to — retry "
                         "once the in-flight save lands")
         self.state, meta = self.ckpt.restore(self.state, epoch)
+        self._reshard_state()
         self._apply_meta(meta)
         self.start_epoch = meta["epoch"] + 1
         self.start_step = 0
+
+    def _reshard_state(self) -> None:
+        """Re-establish the compiled step's state shardings after a
+        checkpoint restore. Orbax restores host-side arrays committed to
+        a single device; the donated jit refuses committed args whose
+        sharding mismatches its in_shardings, so a ZeRO-1
+        (--shard-weight-update) run could train but never RESUME until
+        this device_put (found by the composed-resilience test,
+        VERDICT r4 weak #6). No-op for replicated (default) runs."""
+        if self._state_spec is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self._state_spec,
+            is_leaf=lambda s: isinstance(s, PartitionSpec),
+        )
+        self.state = jax.device_put(self.state, shardings)
 
     def _resume_from_preempt(self, allow_clear: bool = True) -> bool:
         """Restore the newest mid-epoch preemption checkpoint (from
@@ -387,6 +407,7 @@ class Trainer:
             self.state, meta = pmgr.restore(self.state, p_epoch)
         finally:
             pmgr.close()
+        self._reshard_state()
         saved_echo = meta["extra"].get("data_echo", 1)
         if saved_echo != self.data_echo:
             # the step index and PRNG replay are in units of
