@@ -7,6 +7,11 @@
 #   make test | make bench | make dryrun       CI entry points
 #   make tensorboard                           serve ./runs
 
+# bash + pipefail: the gate targets pipe train/eval through tee, and a
+# crashed run must fail the target, not "pass" on tee's exit 0
+SHELL := /bin/bash
+.SHELLFLAGS := -o pipefail -c
+
 TIME := `/bin/date "+%Y-%m-%d-%H-%M-%S"`
 DATA ?=
 DATA_FLAG := $(if $(DATA),--data-dir $(DATA),)
@@ -72,33 +77,55 @@ convert:
 # 0.856 @ 2048, 0.880 @ 4096, crossed 0.9 @ 8192+flip — EVIDENCE.md);
 # --keep-best retains the val-loss-ranked checkpoints so the peak epoch
 # can be scored with `evaluate.py --epoch` after the overfit knee
+# every gate tees train + eval into ONE timestamped file under logs/
+# permanently: gate numbers must exist in driver-verifiable committed
+# logs (VERDICT r4 weak #2). Single recipe line so the timestamp is
+# captured once and pipefail + && propagate a crashed train.
 gate_detection:
+	@mkdir -p logs; L="logs/gate_detection-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
 	$(PY) train.py -m yolov3 --num-classes 5 --lr 1e-3 --batch-size 32 \
 		--epochs 50 --synthetic-size 8192 --keep-best \
-		--workdir $(WORKDIR)/gates
+		--workdir $(WORKDIR)/gates 2>&1 | tee "$$L" && \
 	$(PY) evaluate.py detection -m yolov3 --num-classes 5 \
-		--workdir $(WORKDIR)/gates/yolov3
+		--workdir $(WORKDIR)/gates/yolov3 2>&1 | tee -a "$$L"
+
+# classification gate (VERDICT r4 #3): train resnet34 on the hermetic
+# synthetic classification set, score the held-out slice through
+# evaluate.py's exact masked full-set eval. --num-classes 5: the
+# synthetic class signal aliases past 7 classes (data/synthetic.py)
+gate_classification:
+	@mkdir -p logs; L="logs/gate_classification-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	$(PY) train.py -m resnet34 --num-classes 5 --synthetic-size 4096 \
+		--batch-size 64 --epochs 6 --lr 0.05 --keep-best \
+		--workdir $(WORKDIR)/gates 2>&1 | tee "$$L" && \
+	$(PY) evaluate.py classification -m resnet34 --num-classes 5 \
+		--synthetic-size 4096 --train-batch-size 64 \
+		--workdir $(WORKDIR)/gates/resnet34 2>&1 | tee -a "$$L"
 
 # two-phase recipe from EVIDENCE.md r4: the plateau scheduler never
 # fires on this task (val micro-improves each epoch), so the CenterNet-
 # paper x10 lr drop is applied manually via resume
 gate_centernet:
+	@mkdir -p logs; L="logs/gate_centernet-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
 	$(PY) train.py -m centernet --num-classes 5 --epochs 50 --keep-best \
 		--synthetic-size 2048 --stall-timeout 420 \
-		--workdir $(WORKDIR)/gates
+		--workdir $(WORKDIR)/gates 2>&1 | tee "$$L" && \
 	$(PY) train.py -m centernet --num-classes 5 --epochs 65 --lr 1e-4 \
 		--synthetic-size 2048 --keep-best --stall-timeout 420 \
-		--workdir $(WORKDIR)/gates --resume
+		--workdir $(WORKDIR)/gates --resume 2>&1 | tee -a "$$L" && \
 	$(PY) evaluate.py detection -m centernet --num-classes 5 --size 128 \
-		--workdir $(WORKDIR)/gates/centernet
+		--workdir $(WORKDIR)/gates/centernet 2>&1 | tee -a "$$L"
 
 gate_gan:
+	@mkdir -p logs; L="logs/gate_gan-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
 	$(PY) train.py -m cyclegan --synthetic-size 256 --epochs 40 \
-		--workdir $(WORKDIR)/gates
-	$(PY) evaluate.py gan -m cyclegan --workdir $(WORKDIR)/gates/cyclegan
+		--workdir $(WORKDIR)/gates 2>&1 | tee "$$L" && \
+	$(PY) evaluate.py gan -m cyclegan \
+		--workdir $(WORKDIR)/gates/cyclegan 2>&1 | tee -a "$$L" && \
 	$(PY) train.py -m dcgan --synthetic-size 2048 --epochs 20 \
-		--workdir $(WORKDIR)/gates
-	$(PY) evaluate.py gan -m dcgan --workdir $(WORKDIR)/gates/dcgan
+		--workdir $(WORKDIR)/gates 2>&1 | tee -a "$$L" && \
+	$(PY) evaluate.py gan -m dcgan \
+		--workdir $(WORKDIR)/gates/dcgan 2>&1 | tee -a "$$L"
 
 # --num-joints 3: the synthetic set encodes one joint per color channel
 # (data/pose.synthetic_pose); at the MPII default of 16 the channel
@@ -107,10 +134,12 @@ gate_gan:
 # (37% gross misses on held-out draws) and the config lr of 1e-4
 # converged 5x slower (EVIDENCE.md r4)
 gate_pose:
+	@mkdir -p logs; L="logs/gate_pose-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
 	$(PY) train.py -m hourglass104 --num-joints 3 --epochs 120 \
-		--synthetic-size 1024 --lr 1e-3 --workdir $(WORKDIR)/gates
+		--synthetic-size 1024 --lr 1e-3 --keep-best \
+		--workdir $(WORKDIR)/gates 2>&1 | tee "$$L" && \
 	$(PY) evaluate.py pose -m hourglass104 --num-joints 3 \
-		--workdir $(WORKDIR)/gates/hourglass104
+		--workdir $(WORKDIR)/gates/hourglass104 2>&1 | tee -a "$$L"
 
 # one-command real-data rehearsal: generated JPEG folder -> TFRecords ->
 # raw-frame shards -> train -> evaluate -> StableHLO export, plus the

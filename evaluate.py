@@ -78,9 +78,23 @@ def cmd_classification(args):
         if cfg["dataset"] == "mnist":
             imgs, labels = synthetic_mnist(256)
         else:
-            r = np.random.default_rng(0)
-            labels = r.integers(0, cfg["num_classes"], 256).astype(np.int32)
-            imgs = r.normal(0, 1, (256, size, size, ch)).astype(np.float32)
+            # SAME generator + split as train.py's synthetic fallback:
+            # score exactly the held-out slice the training run never
+            # saw (pass the run's --synthetic-size and --batch-size).
+            # Without --train-batch-size the split is computed with
+            # batch_size=1 — an UNDER-approximation of train.py's
+            # max(batch, n/10) split, so the scored slice is always a
+            # subset of the true held-out set (never leaks training
+            # images; at worst scores a few images fewer).
+            from deepvision_tpu.data.synthetic import (
+                synthetic_classification,
+            )
+
+            imgs, labels, split = synthetic_classification(
+                args.synthetic_size, size, ch, cfg["num_classes"],
+                args.train_batch_size or 1,
+            )
+            imgs, labels = imgs[:split], labels[:split]
         batches = mk(imgs, labels, bs, drop_remainder=False)
 
     from deepvision_tpu.train.steps import aggregate_eval_parts
@@ -407,6 +421,15 @@ def main(argv=None):
     sp.add_argument("--epoch", type=int, default=None,
                     help="saved epoch to score (default latest; with "
                          "--keep-best the best is often not the newest)")
+    sp.add_argument("--synthetic-size", type=int, default=2048,
+                    help="regenerate the train run's synthetic set "
+                         "(pass the SAME value as train.py "
+                         "--synthetic-size; defaults match) and score "
+                         "its held-out slice")
+    sp.add_argument("--train-batch-size", type=int, default=None,
+                    help="the training run's batch size (sizes the "
+                         "held-out split; default 1 under-approximates "
+                         "the split so training images never leak in)")
     sp.set_defaults(fn=cmd_classification)
 
     sp = sub.add_parser("detection")

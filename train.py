@@ -276,13 +276,15 @@ def main():
         n = args.synthetic_size
         if cfg["dataset"] == "mnist":
             imgs, labels = synthetic_mnist(n)
+            split = max(cfg["batch_size"], int(n * 0.1))
         else:
-            r = np.random.default_rng(0)
-            labels = r.integers(0, cfg["num_classes"], n).astype(np.int32)
-            imgs = r.normal(0, 1, (n, size, size, ch)).astype(np.float32)
-            for i in range(n):  # make it learnable
-                imgs[i, :, :, 0] += (labels[i] % 7) * 0.3
-        split = max(cfg["batch_size"], int(n * 0.1))
+            from deepvision_tpu.data.synthetic import (
+                synthetic_classification,
+            )
+
+            imgs, labels, split = synthetic_classification(
+                n, size, ch, cfg["num_classes"], cfg["batch_size"]
+            )
         train_data = lambda e: batches(imgs[split:], labels[split:],
                                        cfg["batch_size"],
                                        rng=np.random.default_rng(e))
