@@ -42,6 +42,116 @@ def sh(*cmd: str) -> str:
     return r.stdout
 
 
+def _plat(args):
+    return ["--platform", args.platform] if args.platform else []
+
+
+def rehearse_detection(root: Path, args) -> dict:
+    """VOC-schema leg: XML tree -> build_voc_tfrecords -> train.py
+    yolov3 -> evaluate.py detection. Real-VOC day is then a data swap
+    (point --data-dir at the real VOCdevkit records)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    voc = root / "voc"
+    for d in ("Annotations", "JPEGImages", "ImageSets/Main"):
+        (voc / d).mkdir(parents=True)
+    names = []
+    classes = ["aeroplane", "car"]  # must be real VOC class names
+    for i in range(8):
+        name = f"im{i:04d}"
+        names.append(name)
+        h, w = (100, 140) if i % 2 else (140, 100)
+        arr = rng.integers(0, 100, (h, w, 3), np.uint8)
+        # one bright box per image, class-colored
+        x0, y0 = int(10 + 20 * (i % 3)), int(8 + 15 * (i % 4))
+        x1, y1 = x0 + 40, y0 + 30
+        arr[y0:y1, x0:x1, i % 2] = 230
+        Image.fromarray(arr).save(voc / "JPEGImages" / f"{name}.jpg",
+                                  "JPEG")
+        (voc / "Annotations" / f"{name}.xml").write_text(f"""
+<annotation><filename>{name}.jpg</filename>
+<size><width>{w}</width><height>{h}</height><depth>3</depth></size>
+<object><name>{classes[i % 2]}</name><bndbox>
+<xmin>{x0}</xmin><ymin>{y0}</ymin><xmax>{x1}</xmax><ymax>{y1}</ymax>
+</bndbox></object></annotation>""")
+    main_dir = voc / "ImageSets" / "Main"
+    (main_dir / "train.txt").write_text("\n".join(names[:6]) + "\n")
+    (main_dir / "val.txt").write_text("\n".join(names[6:]) + "\n")
+
+    records = root / "voc_records"
+    for split in ("train", "val"):
+        sh(sys.executable, "-c",
+           "from deepvision_tpu.data.builders.detection import "
+           "build_voc_tfrecords as b; "
+           f"b(r'{voc}', r'{records}', '{split}', num_shards=2, "
+           "num_workers=1)")
+
+    sh(sys.executable, "train.py", "-m", "yolov3",
+       "--data-dir", str(records), "--workdir", str(root / "runs"),
+       "--input-size", str(args.size), "--batch-size", "4",
+       "--epochs", str(args.epochs), "--steps-per-epoch", "2",
+       "--precision", "f32", "--lr", "1e-4", *_plat(args))
+    out = sh(sys.executable, "evaluate.py", "detection", "-m", "yolov3",
+             "--workdir", str(root / "runs" / "yolov3"),
+             "--data-dir", str(records), "--split", "val",
+             "--size", str(args.size), "--batch-size", "4")
+    metrics = json.loads(
+        [ln for ln in out.splitlines() if ln.startswith("{")][-1])
+    assert metrics["images"] == 2, metrics  # full val split scored
+    return metrics
+
+
+def rehearse_pose(root: Path, args) -> dict:
+    """MPII-schema leg: images + MPII-style JSON -> build_mpii_tfrecords
+    -> train.py hourglass104 -> evaluate.py pose."""
+    from PIL import Image
+
+    rng = np.random.default_rng(2)
+    imgs = root / "mpii_imgs"
+    imgs.mkdir(parents=True)
+    anns = []
+    for i in range(8):
+        h, w = 150, 130
+        arr = rng.integers(0, 120, (h, w, 3), np.uint8)
+        # visible "joints": bright dots at 3 deterministic spots
+        joints = []
+        for j in range(16):
+            x, y = 20 + (j * 7 + i * 5) % 90, 25 + (j * 11 + i * 3) % 100
+            if j < 3:
+                arr[y - 2:y + 2, x - 2:x + 2] = 255
+            joints.append({"id": j, "x": x, "y": y, "visible": 1})
+        name = f"p{i:04d}.jpg"
+        Image.fromarray(arr).save(imgs / name, "JPEG")
+        anns.append({"image": name, "joints": joints,
+                     "center": [w / 2, h / 2], "scale": h / 200.0})
+    (root / "mpii.json").write_text(json.dumps(anns))
+
+    records = root / "mpii_records"
+    for split, lo, hi in (("train", 0, 6), ("val", 6, 8)):
+        sub = root / f"mpii_{split}.json"
+        sub.write_text(json.dumps(anns[lo:hi]))
+        sh(sys.executable, "-c",
+           "from deepvision_tpu.data.builders.pose import "
+           "build_mpii_tfrecords as b; "
+           f"b(r'{imgs}', r'{sub}', r'{records}', '{split}', "
+           "num_shards=2, num_workers=1)")
+
+    sh(sys.executable, "train.py", "-m", "hourglass104",
+       "--data-dir", str(records), "--workdir", str(root / "runs"),
+       "--input-size", str(args.size), "--batch-size", "4",
+       "--epochs", str(args.epochs), "--steps-per-epoch", "2",
+       "--precision", "f32", "--lr", "1e-4", *_plat(args))
+    out = sh(sys.executable, "evaluate.py", "pose", "-m", "hourglass104",
+             "--workdir", str(root / "runs" / "hourglass104"),
+             "--data-dir", str(records), "--split", "val",
+             "--size", str(args.size), "--batch-size", "4")
+    metrics = json.loads(
+        [ln for ln in out.splitlines() if ln.startswith("{")][-1])
+    assert metrics["value"] is not None
+    return metrics
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--workdir", default="/tmp/dvt_rehearsal")
@@ -49,12 +159,26 @@ def main() -> None:
                    help="force a JAX platform for the train/eval steps")
     p.add_argument("--size", type=int, default=64)
     p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--legs", default="classification,detection,pose",
+                   help="comma list of legs to run")
     args = p.parse_args()
 
+    legs = set(args.legs.split(","))
     root = Path(args.workdir)
     if root.exists():
         shutil.rmtree(root)
-    (root / "imgs").mkdir(parents=True)
+    root.mkdir(parents=True)
+    results = {}
+    if "detection" in legs:
+        results["detection"] = rehearse_detection(root, args)
+    if "pose" in legs:
+        results["pose"] = rehearse_pose(root, args)
+    if "classification" not in legs:
+        print("REHEARSAL OK:", json.dumps(results))
+        return
+    # the classification leg below ends with its own REHEARSAL OK line;
+    # fold the other legs' metrics into it via `results`
+    (root / "imgs").mkdir(parents=True, exist_ok=True)
 
     # 1. JPEG folder: deliberately non-square (wide AND tall) so the
     # raw-frame builder's full-support storage is exercised
@@ -112,7 +236,8 @@ def main() -> None:
        "-o", str(root / "resnet34.stablehlo"))
     assert (root / "resnet34.stablehlo").stat().st_size > 0
 
-    print("REHEARSAL OK:", json.dumps(metrics))
+    print("REHEARSAL OK:",
+          json.dumps({**results, "classification": metrics}))
 
 
 if __name__ == "__main__":
