@@ -352,3 +352,27 @@ def test_raw_frame_full_crop_support(tmp_path):
                             wide_cols = True
                         break
     assert wide_cols, "random crops never left the center square"
+
+
+def test_synthetic_classification_split_contract():
+    """train.py and evaluate.py share one generator (data/synthetic.py):
+    the held-out slice evaluate scores must be bit-identical to the one
+    train holds out, and the batch-size-1 fallback must only ever score
+    a SUBSET of the true held-out set (never leak training images)."""
+    from deepvision_tpu.data.synthetic import synthetic_classification
+
+    imgs_a, labels_a, split_a = synthetic_classification(256, 32, 3, 5, 64)
+    imgs_b, labels_b, split_b = synthetic_classification(256, 32, 3, 5, 64)
+    np.testing.assert_array_equal(imgs_a, imgs_b)  # deterministic
+    np.testing.assert_array_equal(labels_a, labels_b)
+    assert split_a == split_b == 64  # max(batch=64, 256//10)
+
+    # the class signal is present and separable for <= 7 classes:
+    # channel-0 mean orders by label
+    ch0 = imgs_a[:, :, :, 0].mean(axis=(1, 2))
+    means = [ch0[labels_a == c].mean() for c in range(5)]
+    assert all(means[i] < means[i + 1] for i in range(4))
+
+    # fallback split (batch_size=1) is a subset of the real held-out set
+    _, _, split_fb = synthetic_classification(256, 32, 3, 5, 1)
+    assert 0 < split_fb <= split_a

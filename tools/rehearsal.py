@@ -5,13 +5,23 @@ make real-data day a data swap, not a debug session).
 
     python tools/rehearsal.py [--workdir DIR] [--platform cpu]
 
-Chain (each step a real subprocess through the shipped CLIs):
+Three legs, selected with ``--legs`` (default: all three; each step a
+real subprocess through the shipped CLIs):
+
+classification:
   1. generate a JPEG folder (non-square images, 2 synsets) + synsets.txt
   2. deepvision_tpu.data.builders.imagenet  -> train/validation TFRecords
   3. deepvision_tpu.data.builders.raw_crops -> raw-frame fast-path shards
   4. train.py   -m resnet34 --data-dir ...  (raw fast path auto-enables)
   5. evaluate.py classification             (masked full-set top-1/5)
   6. predict.py export                      (StableHLO artifact)
+
+detection (VOC schema): miniature VOCdevkit tree (XML annotations,
+JPEGImages, ImageSets) -> build_voc_tfrecords -> train.py yolov3
+--data-dir -> evaluate.py detection over the full val split.
+
+pose (MPII schema): images + MPII-style JSON -> build_mpii_tfrecords
+-> train.py hourglass104 --data-dir -> evaluate.py pose.
 
 The checkpoint-converter leg (reference .pt -> Orbax -> logit parity) is
 covered by ``make rehearsal``'s pytest step — the rehearsal of
@@ -125,7 +135,6 @@ def rehearse_pose(root: Path, args) -> dict:
         Image.fromarray(arr).save(imgs / name, "JPEG")
         anns.append({"image": name, "joints": joints,
                      "center": [w / 2, h / 2], "scale": h / 200.0})
-    (root / "mpii.json").write_text(json.dumps(anns))
 
     records = root / "mpii_records"
     for split, lo, hi in (("train", 0, 6), ("val", 6, 8)):
@@ -163,7 +172,13 @@ def main() -> None:
                    help="comma list of legs to run")
     args = p.parse_args()
 
-    legs = set(args.legs.split(","))
+    legs = {leg.strip() for leg in args.legs.split(",") if leg.strip()}
+    known = {"classification", "detection", "pose"}
+    if not legs or legs - known:
+        # a typo'd leg silently skipping work would print REHEARSAL OK
+        # while rehearsing nothing
+        raise SystemExit(
+            f"--legs must name legs from {sorted(known)}; got {args.legs!r}")
     root = Path(args.workdir)
     if root.exists():
         shutil.rmtree(root)
@@ -211,7 +226,7 @@ def main() -> None:
 
     # 4. train through the shipped CLI (raw fast path auto-enables with
     # the printed notice)
-    plat = ["--platform", args.platform] if args.platform else []
+    plat = _plat(args)
     out = sh(sys.executable, "train.py", "-m", "resnet34",
              "--data-dir", str(records), "--workdir", str(root / "runs"),
              "--num-classes", "2", "--input-size", str(args.size),
