@@ -276,6 +276,25 @@ def _zoo_case(name):
         tx = optax.rmsprop(2.5e-4)
         state = create_train_state(model, tx, batch["image"][:1])
         return state, batch, S.pose_train_step
+    if name == "dcgan":
+        # the zoo's one non-classification-step family: the full
+        # simultaneous G+D update (two Adams, one shared forward) is the
+        # compiled program, exactly what fit_gan runs at the trained
+        # config (batch 256, 28x28x1, train/configs.py "dcgan")
+        from deepvision_tpu.train.gan import (
+            create_dcgan_state,
+            dcgan_train_step,
+        )
+
+        bs = 256
+        batch = {
+            "image": rng.normal(size=(bs, 28, 28, 1)).astype(np.float32)
+        }
+        state = create_dcgan_state(
+            get_model("dcgan_generator", dtype=jnp.bfloat16),
+            get_model("dcgan_discriminator", dtype=jnp.bfloat16),
+        )
+        return state, batch, dcgan_train_step
     raise KeyError(name)
 
 
@@ -288,7 +307,8 @@ def _zoo_bench(mesh, n_chips, kind, peak_bf16,
     out = {}
     t_start = time.perf_counter()
     for fam, f32 in (("mobilenet1", False), ("inception3", False),
-                     ("yolov3", False), ("hourglass104", True)):
+                     ("yolov3", False), ("hourglass104", True),
+                     ("dcgan", False)):
         if time.perf_counter() - t_start > budget_s:
             # relay compiles are erratic (2-9 min each); never let the
             # zoo sweep endanger the headline line
