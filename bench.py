@@ -310,6 +310,24 @@ def _zoo_case(name):
             get_model("dcgan_discriminator", dtype=jnp.bfloat16),
         )
         return state, batch, dcgan_train_step
+    if name == "cyclegan":
+        # trained config (train/configs.py "cyclegan"): batch 4 @ 256²,
+        # full two-phase step (both G updates + both pooled D updates)
+        from deepvision_tpu.train.gan import (
+            create_cyclegan_state,
+            cyclegan_train_step,
+        )
+
+        bs = max(4, jax.device_count())  # 4 per trained config; divisible
+        batch = {                        # by the data axis on multi-chip
+            "a": rng.normal(size=(bs, 256, 256, 3)).astype(np.float32),
+            "b": rng.normal(size=(bs, 256, 256, 3)).astype(np.float32),
+        }
+        state = create_cyclegan_state(
+            get_model("cyclegan_generator", dtype=jnp.bfloat16),
+            get_model("cyclegan_discriminator", dtype=jnp.bfloat16),
+        )
+        return state, batch, cyclegan_train_step
     raise KeyError(name)
 
 
@@ -327,7 +345,7 @@ def _zoo_bench(mesh, n_chips, kind, peak_bf16,
     for fam, f32 in (("mobilenet1", False), ("inception3", False),
                      ("yolov3", False), ("hourglass104", True),
                      ("dcgan", False), ("shufflenet1", False),
-                     ("centernet", False)):
+                     ("centernet", False), ("cyclegan", False)):
         if time.perf_counter() - t_start > budget_s:
             # relay compiles are erratic (2-9 min each); never let the
             # zoo sweep endanger the headline line
@@ -361,7 +379,11 @@ def _zoo_bench(mesh, n_chips, kind, peak_bf16,
                 state, _m = compiled(state, db, sub)
             drain(state)
             dt = time.perf_counter() - t0
-            bs = len(batch["image"])
+            # images consumed per step: the "image" tensor, or — for
+            # image-only batches like cyclegan's {'a','b'} — every
+            # domain's reals, matching the other families' convention
+            bs = (len(batch["image"]) if "image" in batch
+                  else sum(len(v) for v in batch.values()))
             step_t = dt / n
             # f32 MACs run at half the bf16 MXU rate
             peak = peak_bf16 / (2.0 if f32 else 1.0)
