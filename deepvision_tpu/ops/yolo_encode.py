@@ -52,7 +52,8 @@ def encode_labels(boxes, labels, num_classes: int, *,
                   grid_sizes=GRID_SIZES):
     """boxes (B, M, 4) xywh normalized to [0,1]; labels (B, M) int32 with
     -1 for padding -> tuple of 3 grids, each
-    (B, S, S, 3, 5 + num_classes) float32.
+    (B, S, S, 3, 5 + num_classes) in the dtype boxes promote to with
+    f32 (f32 in training, f64 under the x64 parity tests).
     """
     b, m, _ = boxes.shape
     anchor_idx = best_anchor(boxes[..., 2:4])  # (B, M) in [0, 9)
@@ -75,8 +76,11 @@ def encode_labels(boxes, labels, num_classes: int, *,
         on_scale = valid & (scale_idx == s)
         # invalid rows scatter out of bounds -> dropped by XLA
         oob = jnp.where(on_scale, 0, size + 1)
+        # match the boxes' dtype: f32 in training, f64 under the spatial
+        # parity tests (a f32 grid there forces a lossy scatter cast that
+        # newer JAX promotes to an error)
         grid = jnp.zeros((b, size, size, 3, features.shape[-1]),
-                         jnp.float32)
+                         features.dtype)
         grid = grid.at[
             batch_idx, cell_y + oob, cell_x, within
         ].set(features, mode="drop")
