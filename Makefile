@@ -89,6 +89,11 @@ gate_detection:
 	$(PY) evaluate.py detection -m yolov3 --num-classes 5 \
 		--workdir $(WORKDIR)/gates/yolov3 2>&1 | tee -a "$$L"
 
+# the 16384-image scaling-curve point (~4h on one v5e chip): supervised
+# restart loop around the same recipe at 2x data, tools/run_yolo_16384.sh
+gate_detection_16384:
+	bash tools/run_yolo_16384.sh
+
 # classification gate (VERDICT r4 #3): train resnet34 on the hermetic
 # synthetic classification set, score the held-out slice through
 # evaluate.py's exact masked full-set eval. --num-classes 5: the
