@@ -131,6 +131,7 @@ class Trainer:
         data_echo: int = 1,
         stall_timeout: float | None = None,
         stall_abort: bool = False,
+        rss_limit_gb: float | None = None,
     ):
         self.model = model
         self.config = config
@@ -207,6 +208,22 @@ class Trainer:
             StallWatchdog(stall_timeout, abort=stall_abort)
             if stall_timeout else None
         )
+        # host-RSS self-preemption: the axon relay TPU client leaks
+        # ~one staged input batch of host memory per device_put (the
+        # framework's own loop is leak-free — tools RSS check on CPU
+        # holds flat over hundreds of steps), so multi-hour runs grow
+        # without bound and an eventual OOM kill (SIGKILL, no save)
+        # loses the epoch. Crossing the limit triggers the EXISTING
+        # preemption path instead: sync mid-epoch checkpoint, exit 143,
+        # supervised relaunch into bit-exact --resume with a fresh
+        # process (and a fresh, small RSS). Checked at step granularity
+        # (cheap: one /proc read per log_every batches).
+        self.rss_limit_bytes = (
+            int(rss_limit_gb * 1e9) if rss_limit_gb else None
+        )
+        if self.rss_limit_bytes is not None:
+            _check_rss_limit_sane(self.rss_limit_bytes)
+        self._rss_preempted = False
         # per-epoch stream derived in train_epoch: _key is only valid
         # inside an epoch
         self._base_key = jax.random.key(seed + 1)
@@ -493,6 +510,19 @@ class Trainer:
             # otherwise starve beats and false-trip healthy runs).
             if self._watchdog and i % min(32, self.log_every or 32) == 0:
                 drain()
+            if (self.rss_limit_bytes
+                    and i % (self.log_every or 32) == 0):
+                rss = _process_rss()
+                if rss > self.rss_limit_bytes:
+                    print(
+                        f"[rss-limit] host RSS {rss/1e9:.2f}GB > "
+                        f"{self.rss_limit_bytes/1e9:.2f}GB — "
+                        "self-preempting (mid-epoch save; relaunch with "
+                        "--resume to continue in a fresh process)",
+                        flush=True,
+                    )
+                    self._rss_preempted = True
+                    self.request_preempt()
             if self._preempt:
                 # batch-granular: the resume point is a transferred-batch
                 # index, so a preemption mid-echo-group replays the group
@@ -723,6 +753,74 @@ class StallWatchdog:
                 if self.abort:
                     self._exit(75)
                 self._last = time.monotonic()  # warn again, don't spam
+
+
+def _process_rss(*, honor_fake: bool = True) -> int:
+    """Current process resident set size in bytes — one ``/proc`` read,
+    no third-party dependency (psutil is not in requirements.txt).
+    Returns 0 where /proc is unavailable (the limit check then never
+    fires, which degrades to "no RSS watchdog" rather than a crash).
+
+    ``DVTPU_FAKE_RSS`` (bytes) is a test hook for the in-loop check —
+    the ctor-time sanity guard ignores it (``honor_fake=False``) so a
+    faked huge RSS cannot make construction itself fail."""
+    fake = os.environ.get("DVTPU_FAKE_RSS")
+    if honor_fake and fake:
+        try:
+            return int(fake)
+        except ValueError:
+            pass  # malformed hook value: fall through to the real RSS
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _check_rss_limit_sane(limit_bytes: int) -> None:
+    """A limit at/below the process's CURRENT RSS would fire on batch 0
+    of every relaunch: each restart pays full XLA recompilation to
+    advance one batch — the run looks alive but effectively stalls.
+    Fail at construction instead, with the number the operator needs."""
+    now = _process_rss(honor_fake=False)
+    if now and limit_bytes <= now:
+        raise ValueError(
+            f"rss limit {limit_bytes/1e9:.2f}GB is at/below the current "
+            f"process RSS {now/1e9:.2f}GB — every relaunch would "
+            "immediately re-preempt after one batch; raise the limit "
+            "above the steady-state baseline")
+
+
+def make_rss_limit_flag(limit_gb: float) -> Callable[[], bool]:
+    """Zero-arg RSS-limit poll for loops that take a ``preempt``
+    callable instead of a Trainer (``fit_gan``): returns True — and
+    stays True — once host RSS crosses ``limit_gb``. LATCHED like
+    make_preempt_flag, and for the same reason: the caller re-polls
+    after the loop to decide the exit-143 path, and RSS may have
+    dropped back under the limit by then (epoch buffers freed) — an
+    unlatched flag would let a preempted run masquerade as complete.
+    Same relaunch-storm guard at creation as the Trainer ctor."""
+    limit = int(limit_gb * 1e9)
+    _check_rss_limit_sane(limit)
+    fired = {"rss": False}
+
+    def exceeded() -> bool:
+        if fired["rss"]:
+            return True
+        rss = _process_rss()
+        if rss > limit:
+            fired["rss"] = True
+            print(
+                f"[rss-limit] host RSS {rss/1e9:.2f}GB > "
+                f"{limit/1e9:.2f}GB — stopping for a supervised "
+                "relaunch (--resume)",
+                flush=True,
+            )
+            return True
+        return False
+
+    return exceeded
 
 
 def make_preempt_flag(signals=(signal.SIGTERM,)) -> Callable[[], bool]:

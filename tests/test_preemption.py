@@ -109,6 +109,47 @@ def test_preempt_resume_is_bit_identical(tmp_path, mesh8):
         np.testing.assert_array_equal(w, g)
 
 
+def test_rss_limit_self_preempts(tmp_path, mesh8, monkeypatch):
+    """Crossing --rss-limit-gb must route into the normal preemption
+    path: mid-epoch save to ckpt_preempt/, .preempted set (the train.py
+    CLI then exits 143 for a supervised --resume relaunch). Guards the
+    mitigation for the relay client's per-transfer host memory leak
+    (multi-hour runs otherwise die in an OOM SIGKILL with no save).
+    DVTPU_FAKE_RSS trips the in-loop check deterministically; the
+    ctor-time storm guard ignores the fake (honor_fake=False) so
+    construction with a sane limit still succeeds."""
+    from deepvision_tpu.data.mnist import synthetic_mnist
+
+    imgs, labels = synthetic_mnist(64)
+    monkeypatch.setenv("DVTPU_FAKE_RSS", str(10**15))  # 1000 TB
+    t = _make_trainer(tmp_path / "rss", mesh8, imgs, labels,
+                      rss_limit_gb=1000.0)
+    t.fit(2)
+    assert t.preempted and t._rss_preempted
+    assert (tmp_path / "rss" / "lenet5" / "ckpt_preempt").exists()
+    t.ckpt.close()
+
+    # resume path is the standard one: picks up the mid-epoch point
+    monkeypatch.delenv("DVTPU_FAKE_RSS")
+    t2 = _make_trainer(tmp_path / "rss", mesh8, imgs, labels)
+    t2.resume()
+    assert t2.start_epoch == 0 and t2.start_step > 0
+    t2.ckpt.close()
+
+
+def test_rss_limit_below_baseline_rejected(tmp_path, mesh8):
+    """A limit at/below the process's current RSS would re-preempt on
+    batch 0 of every relaunch (one batch of progress per full XLA
+    recompile) — the ctor must reject it with the numbers the operator
+    needs, not start the storm."""
+    from deepvision_tpu.data.mnist import synthetic_mnist
+
+    imgs, labels = synthetic_mnist(64)
+    with pytest.raises(ValueError, match="at/below the current"):
+        _make_trainer(tmp_path / "low", mesh8, imgs, labels,
+                      rss_limit_gb=1e-6)
+
+
 def test_preempt_during_validate_stops_after_epoch(tmp_path, mesh8):
     """A signal landing between train_epoch and the epoch save commits
     the full epoch and stops WITHOUT a preemption checkpoint."""

@@ -18,6 +18,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepvision_tpu.core import create_mesh
+from deepvision_tpu.core.step import compiler_options
 from deepvision_tpu.train.state import create_train_state
 from deepvision_tpu.train.steps import (
     classification_train_step,
@@ -59,6 +60,7 @@ def _run_step(mesh, spatial, images, labels):
         classification_train_step,
         in_shardings=(rep, {"image": img_sh, "label": lbl_sh}, rep),
         out_shardings=(rep, rep),
+        compiler_options=compiler_options(),
     )
     batch = {
         "image": jax.device_put(images, img_sh),
@@ -108,6 +110,7 @@ def test_spatial_eval_matches(rng):
         classification_eval_step,
         in_shardings=(rep, {"image": img_sh, "label": lbl_sh}),
         out_shardings=rep,
+        compiler_options=compiler_options(),
     )
     out = ev(
         state,
@@ -137,10 +140,16 @@ def _spatial_vs_data_parity(train_step, state, batch, extra_data_keys,
         rep = NamedSharding(mesh, P())
         from deepvision_tpu.core.step import _in_spatial_scope
 
+        # compiler_options: without it a raw jax.jit keeps XLA:CPU's 40s
+        # collective terminate timeout, which the 8 single-core-
+        # timeshared device threads of this f64 step exceed on a loaded
+        # host — XLA then ABORTS the whole pytest process
+        # (rendezvous.cc; observed in the r5 full-suite run).
         step = jax.jit(
             _in_spatial_scope(train_step, mesh),  # thin-H guard active
             in_shardings=(rep, shardings, rep),
             out_shardings=(rep, rep),
+            compiler_options=compiler_options(),
         )
         dbatch = {k: jax.device_put(v, shardings[k])
                   for k, v in batch.items()}

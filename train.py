@@ -92,6 +92,12 @@ def parse_args():
                    help="on stall, exit 75 (EX_TEMPFAIL) so a "
                         "supervisor restarts into --resume instead of "
                         "hanging forever")
+    p.add_argument("--rss-limit-gb", type=float, default=0.0,
+                   help="self-preempt (mid-epoch save + exit 143) when "
+                        "host RSS crosses this many GB (0 = off) — "
+                        "outruns the relay client's per-transfer host "
+                        "memory leak on multi-hour runs; a supervisor "
+                        "relaunches into --resume with a fresh process")
     p.add_argument("--label-smooth", type=float, default=0.0,
                    help="one-sided label smoothing on the DCGAN "
                         "discriminator's real targets (Salimans et al. "
@@ -345,7 +351,8 @@ def main():
         async_checkpoint=args.async_checkpoint,
         keep_best=args.keep_best, data_echo=args.data_echo,
         stall_timeout=args.stall_timeout or None,
-        stall_abort=args.stall_abort, **step_fns,
+        stall_abort=args.stall_abort,
+        rss_limit_gb=args.rss_limit_gb or None, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
@@ -492,6 +499,16 @@ def run_gan(args, cfg, dtype):
     )
 
     preempted = make_preempt_flag()
+    # --rss-limit-gb on the GAN path: the epoch-granular preempt poll
+    # doubles as the RSS check (fit_gan saves at epoch boundaries, so
+    # "stop after this epoch + exit 143 + supervised --resume" is the
+    # right granularity here)
+    if args.rss_limit_gb:
+        from deepvision_tpu.train.trainer import make_rss_limit_flag
+
+        rss_exceeded = make_rss_limit_flag(args.rss_limit_gb)
+        sigterm = preempted
+        preempted = lambda: sigterm() or rss_exceeded()  # noqa: E731
     watchdog = (StallWatchdog(args.stall_timeout, abort=args.stall_abort)
                 if args.stall_timeout else None)
     fit_gan(
