@@ -12,7 +12,10 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
 if "xla_cpu_collective_timeout_seconds" not in flags:
-    flags += " --xla_cpu_collective_timeout_seconds=1200"
+    # keep aligned with the rendezvous terminate timeout below — both
+    # govern the same collective path; disagreeing values cap the
+    # effective window at the smaller one
+    flags += " --xla_cpu_collective_timeout_seconds=7200"
 os.environ["XLA_FLAGS"] = flags
 # XLA:CPU hard-aborts the whole process ("Exiting to ensure a consistent
 # program state", rendezvous.cc) when the 8 virtual-device threads reach
@@ -23,9 +26,13 @@ os.environ["XLA_FLAGS"] = flags
 # hook (core/step.compiler_options) instead.
 os.environ.setdefault(
     "DVT_COMPILER_OPTIONS",
-    "xla_cpu_collective_call_terminate_timeout_seconds=1200"
+    "xla_cpu_collective_call_terminate_timeout_seconds=7200"
     ",xla_cpu_collective_call_warn_stuck_seconds=120",
 )
+# NOTE the abort is easy to misread as a silent crash: pytest's default
+# fd-level capture swallows XLA's rendezvous F-check message (the
+# buffer dies with the process), so only faulthandler's "Fatal Python
+# error: Aborted" reaches the log. Run with -s to see native messages.
 # Keep tf (host data pipelines) off any accelerator and quiet.
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
