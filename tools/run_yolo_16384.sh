@@ -15,9 +15,12 @@ WORKDIR=runs/gates16k
 RESUME=""
 for attempt in $(seq 1 8); do
   echo "[supervisor] attempt $attempt (resume='$RESUME')" | tee -a "$L"
+  # --rss-limit-gb: outrun the relay client's per-transfer host leak
+  # (~9 MB/step; tools/leak_check.py) — self-preempt + relaunch resets
+  # the process RSS long before the box OOMs
   python train.py -m yolov3 --num-classes 5 --lr 1e-3 --batch-size 32 \
     --epochs 30 --synthetic-size 16384 --keep-best \
-    --stall-timeout 600 --stall-abort \
+    --stall-timeout 600 --stall-abort --rss-limit-gb 80 \
     --workdir "$WORKDIR" $RESUME 2>&1 | tee -a "$L"
   code=${PIPESTATUS[0]}
   if [ "$code" -eq 0 ]; then
