@@ -102,7 +102,7 @@ gate_detection_16384:
 # (both scored held-out top-1 1.0 on-chip, EVIDENCE.md r5)
 gate_classification: MODEL ?= resnet34
 gate_classification:
-	@mkdir -p logs; L="logs/gate_classification-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	@mkdir -p logs; L="logs/gate_classification_$(MODEL)-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
 	$(PY) train.py -m $(MODEL) --num-classes 5 --synthetic-size 4096 \
 		--batch-size 64 --epochs 6 --lr 0.05 --keep-best \
 		--workdir $(WORKDIR)/gates 2>&1 | tee "$$L" && \
