@@ -43,6 +43,20 @@ test:
 smoke:
 	$(PY) -m pytest tests/ -m smoke -x -q
 
+# TPU-hazard static analysis + the registry-wide abstract-eval gate
+# (tools/jaxlint/; suppressions + baseline in jaxlint.toml). Seconds-
+# cheap, runs on every PR via `make check`.
+lint:
+	$(PY) -m tools.jaxlint deepvision_tpu/
+	$(PY) -m tools.jaxlint.evalcheck
+
+# the default CI path: hazard lint + whole-zoo shape gate + full suite
+# (the suite's own full-registry evalcheck test is deselected — `lint`
+# above just ran the identical ~2-min gate via the CLI)
+check: lint
+	$(PY) -m pytest tests/ -x -q \
+		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
+
 bench:
 	$(PY) bench.py
 
@@ -163,4 +177,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint check bench dryrun tensorboard find-python list-models rehearsal

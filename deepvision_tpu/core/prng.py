@@ -29,7 +29,13 @@ def split_like(key: jax.Array, tree):
 
 
 class KeySeq:
-    """Stateful host-side key sequence: ``next(seq)`` -> fresh subkey."""
+    """Stateful host-side key sequence: ``next(seq)`` -> fresh subkey.
+
+    This is the ONE blessed manual-threading idiom (jaxlint JX103
+    treats ``next(KeySeq)`` as minting a fresh key): epoch loops build
+    ``KeySeq(jax.random.fold_in(base, epoch))`` and draw one subkey per
+    step instead of open-coding ``key, sub = jax.random.split(key)``.
+    """
 
     def __init__(self, seed_or_key):
         if isinstance(seed_or_key, int):
@@ -43,3 +49,12 @@ class KeySeq:
     def take(self, n: int) -> jax.Array:
         self._key, *subs = jax.random.split(self._key, n + 1)
         return jnp.stack(subs)
+
+    def skip(self, n: int) -> "KeySeq":
+        """Advance past ``n`` draws without returning them — replays the
+        split chain to a mid-epoch resume point bit-identically to the
+        uninterrupted run (each skipped position advances the chain
+        exactly as ``next`` would)."""
+        for _ in range(n):
+            self._key, _ = jax.random.split(self._key)
+        return self

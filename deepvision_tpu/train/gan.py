@@ -504,10 +504,14 @@ def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
                     preempt, watchdog):
     from deepvision_tpu.data.device_put import device_prefetch
 
+    from deepvision_tpu.core.prng import KeySeq
+
     for epoch in range(start_epoch, epochs):
-        # epoch-derived noise stream: resume reproduces the uninterrupted
-        # run's z draws / pool coin flips (same rationale as Trainer)
-        key = jax.random.fold_in(base_key, epoch)
+        # epoch-derived noise stream (core.prng.KeySeq, the blessed
+        # threading idiom — jaxlint JX103): resume reproduces the
+        # uninterrupted run's z draws / pool coin flips (same rationale
+        # as Trainer)
+        keys = KeySeq(jax.random.fold_in(base_key, epoch))
         t0 = time.time()
         # pending/drain split (same as Trainer.train_epoch): metrics stay
         # device-side until a drain, so the dispatch queue keeps running —
@@ -527,8 +531,7 @@ def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
         for i, device_batch in enumerate(
             device_prefetch(train_data(epoch), mesh)
         ):
-            key, sub = jax.random.split(key)
-            state, metrics = step(state, device_batch, sub)
+            state, metrics = step(state, device_batch, next(keys))
             pending.append(metrics)
             # beats land only in drain() (per COMPLETED step) — a
             # dispatch-side beat would mask a wedged device until the

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from deepvision_tpu.core import shard_batch
+from deepvision_tpu.core.prng import KeySeq
 from deepvision_tpu.core.step import compile_eval_step, compile_train_step
 from deepvision_tpu.data.device_put import device_prefetch
 from deepvision_tpu.train.checkpoint import CheckpointManager
@@ -224,8 +225,7 @@ class Trainer:
         if self.rss_limit_bytes is not None:
             _check_rss_limit_sane(self.rss_limit_bytes)
         self._rss_preempted = False
-        # per-epoch stream derived in train_epoch: _key is only valid
-        # inside an epoch
+        # per-epoch KeySeq derived in train_epoch from this root key
         self._base_key = jax.random.key(seed + 1)
 
     # -- preemption ------------------------------------------------------
@@ -459,14 +459,14 @@ class Trainer:
         replays the PRNG split chain, so the remaining steps are
         bit-identical to the uninterrupted run). Returns None when
         preempted mid-epoch (partial aggregates would be misleading)."""
-        # epoch-derived PRNG stream: together with the epoch-seeded data
-        # order this makes resume-at-epoch-N bit-identical to an
-        # uninterrupted run reaching epoch N (dropout masks, GAN noise)
-        self._key = jax.random.fold_in(self._base_key, epoch)
-        # replay the consumed chain positions (echo steps consume
-        # data_echo splits per batch)
-        for _ in range(start_step * self.data_echo):
-            self._key, _ = jax.random.split(self._key)
+        # epoch-derived PRNG stream (core.prng.KeySeq — the one blessed
+        # threading idiom, jaxlint JX103): together with the epoch-seeded
+        # data order this makes resume-at-epoch-N bit-identical to an
+        # uninterrupted run reaching epoch N (dropout masks, GAN noise).
+        # skip() replays the consumed chain positions (echo steps
+        # consume data_echo draws per batch).
+        keys = KeySeq(jax.random.fold_in(self._base_key, epoch))
+        keys.skip(start_step * self.data_echo)
         t0 = time.perf_counter()
         counts: list[int] = []
         pending: list[dict] = []  # device scalars not yet fetched
@@ -496,9 +496,8 @@ class Trainer:
             device_prefetch(counted(), self.mesh)
         ):
             for _ in range(self.data_echo):  # device-side batch reuse
-                self._key, sub = jax.random.split(self._key)
                 self.state, metrics = self._train_step(
-                    self.state, device_batch, sub
+                    self.state, device_batch, next(keys)
                 )
                 pending.append(metrics)
             # heartbeats land only in drain() (per COMPLETED step): a

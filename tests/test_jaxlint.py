@@ -1,0 +1,739 @@
+"""jaxlint: one failing + one passing fixture per checker code, the
+suppression/baseline machinery, the repo gate itself, and the
+registry-wide abstract-eval gate (tools/jaxlint/)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.jaxlint.config import (
+    BaselineEntry,
+    LintConfig,
+    load_config,
+    loads_toml,
+)
+from tools.jaxlint.core import run_paths
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def lint(tmp_path, rel: str, src: str, cfg: LintConfig | None = None,
+         **kw):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    cfg = cfg or LintConfig(
+        traced_dirs=["traced"], data_dirs=["data"],
+        parallel_dirs=["parallel"],
+    )
+    return run_paths([p], cfg, root=tmp_path, **kw)
+
+
+def codes(result) -> list[str]:
+    return [f.code for f in result.findings]
+
+
+# ----------------------------------------------------------- JX101
+
+
+def test_jx101_flags_host_sync_in_traced_code(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import numpy as np
+
+        def fused_op(x):
+            v = np.asarray(x)
+            s = x.item()
+            return v, s
+        """)
+    assert codes(r) == ["JX101", "JX101"]
+    assert "device->host" in r.findings[1].message
+
+
+def test_jx101_flags_float_on_traced_value(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import jax.numpy as jnp
+
+        def reduce_op(x):
+            m = jnp.max(x)
+            return float(m)
+        """)
+    assert codes(r) == ["JX101"]
+
+
+def test_jx101_passes_trace_safe_conversions(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import jax.numpy as jnp
+
+        def fused_op(x, max_radius):
+            v = jnp.asarray(x)                 # trace-safe
+            rows = float(x.shape[0])           # static shape read
+            cap = jnp.minimum(v, float(max_radius))  # python scalar
+            return v, rows, cap
+        """)
+    assert codes(r) == []
+
+
+def test_jx101_reachability_through_jit_callgraph(tmp_path):
+    # helper is flagged because step (passed to jax.jit) calls it —
+    # the file is NOT in a traced dir
+    r = lint(tmp_path, "lib/pipeline.py", """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        def forward(x):
+            return helper(x)
+
+        f = jax.jit(forward)
+        """)
+    assert codes(r) == ["JX101"]
+
+
+# ----------------------------------------------------------- JX102
+
+
+def test_jx102_flags_python_branch_on_traced(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import jax.numpy as jnp
+
+        def clamp(x):
+            m = jnp.max(x)
+            if m > 0:
+                return x
+            return -x
+        """)
+    assert codes(r) == ["JX102"]
+    assert "lax.cond" in r.findings[0].message
+
+
+def test_jx102_flags_while_on_traced(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import jax.numpy as jnp
+
+        def iterate(x):
+            err = jnp.sum(x)
+            while err > 1e-3:
+                err = err * 0.5
+            return err
+        """)
+    assert codes(r) == ["JX102"]
+
+
+def test_jx102_passes_static_branches(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def block(x, train: bool = False, mask=None, kind="imagenet"):
+            if train:                      # static python bool
+                x = x * 2
+            if mask is None:               # None-check
+                mask = jnp.ones(x.shape[0])
+            if kind == "imagenet":         # static string
+                x = x - 0.5
+            if x.shape[0] > 2:             # shape read is static
+                x = x[:2]
+            if x.dtype != jnp.float32:     # dtype read is static
+                x = x.astype(jnp.float32)
+            if jax.device_count() > 1:     # static-returning jax call
+                x = x + 0
+            return x * mask
+        """)
+    assert codes(r) == []
+
+
+# ----------------------------------------------------------- JX103
+
+
+def test_jx103_flags_key_reuse(tmp_path):
+    r = lint(tmp_path, "lib/steps.py", """
+        import jax
+
+        def my_train_step(state, batch, key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+        """)
+    assert codes(r) == ["JX103"]
+    assert "'key'" in r.findings[0].message
+
+
+def test_jx103_flags_use_after_split(tmp_path):
+    r = lint(tmp_path, "lib/steps.py", """
+        import jax
+
+        def my_train_step(state, batch, key):
+            k1, k2 = jax.random.split(key)       # consumes key
+            noise = jax.random.normal(key, (2,)) # ...then reuses it
+            return k1, k2, noise
+        """)
+    assert codes(r) == ["JX103"]
+
+
+def test_jx103_flags_per_iteration_reuse_in_loop(tmp_path):
+    r = lint(tmp_path, "lib/host.py", """
+        import jax
+
+        def sample_epoch(key, batches):
+            out = []
+            for b in batches:
+                out.append(jax.random.normal(key, (2,)))
+            return out
+        """)
+    assert codes(r) == ["JX103"]
+
+
+def test_jx103_passes_split_fold_and_keyseq_idioms(tmp_path):
+    r = lint(tmp_path, "lib/host.py", """
+        import jax
+        from deepvision_tpu.core.prng import KeySeq
+
+        def my_train_step(state, batch, key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            return a + b
+
+        def epoch_loop(base_key, epochs, batches):
+            for epoch in range(epochs):
+                # per-epoch derivation from one base is blessed
+                keys = KeySeq(jax.random.fold_in(base_key, epoch))
+                for b in batches:
+                    yield jax.random.normal(next(keys), (2,))
+
+        def threaded(key, batches):
+            for b in batches:
+                key, sub = jax.random.split(key)
+                yield jax.random.normal(sub, (2,))
+        """)
+    assert codes(r) == []
+
+
+def test_jx103_ignores_non_jax_keys(tmp_path):
+    # numpy Generators and checkpoint-key STRINGS ride the same names
+    r = lint(tmp_path, "lib/host.py", """
+        import re
+        import numpy as np
+
+        def jitter(rng: np.random.Generator, image):
+            fb = float(rng.uniform(0.6, 1.4))
+            fc = float(rng.uniform(0.6, 1.4))
+            return image * fb + fc
+
+        def map_key(key: str):
+            if re.fullmatch("conv1.weight", key):
+                return ("conv", "kernel")
+            m = re.fullmatch("bn1.(w+)", key)
+            return m and m.group(1)
+        """)
+    assert codes(r) == []
+
+
+# ----------------------------------------------------------- JX104
+
+
+def test_jx104_flags_undonated_step(tmp_path):
+    r = lint(tmp_path, "lib/compile.py", """
+        import jax
+
+        def train_step(state, batch, key):
+            return state, {}
+
+        step = jax.jit(train_step)
+        """)
+    assert codes(r) == ["JX104"]
+    assert "donate_argnums" in r.findings[0].message
+
+
+def test_jx104_flags_undonated_jit_decorator(tmp_path):
+    r = lint(tmp_path, "lib/compile.py", """
+        import jax
+
+        @jax.jit
+        def update_step(state, batch):
+            return state
+        """)
+    assert codes(r) == ["JX104"]
+
+
+def test_jx104_flags_partial_jit_decorator(tmp_path):
+    r = lint(tmp_path, "lib/compile.py", """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def scan_step(state, n=4):
+            return state
+        """)
+    assert codes(r) == ["JX104"]
+    # ...and donating through the partial passes
+    r = lint(tmp_path, "lib/compile2.py", """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def scan_step(state, n=4):
+            return state
+        """)
+    assert codes(r) == []
+
+
+def test_jx104_passes_donated_and_non_step_jits(tmp_path):
+    r = lint(tmp_path, "lib/compile.py", """
+        import jax
+
+        def train_step(state, batch, key):
+            return state, {}
+
+        def forward(x):
+            return x * 2
+
+        step = jax.jit(train_step, donate_argnums=(0,))
+        infer = jax.jit(forward)    # no state taken: donation optional
+        """)
+    assert codes(r) == []
+
+
+# ----------------------------------------------------------- JX105
+
+
+def test_jx105_flags_float_and_unhashable_statics(tmp_path):
+    r = lint(tmp_path, "lib/compile.py", """
+        import jax
+
+        def forward(x, lr=1e-3, dims=[1, 2]):
+            return x * lr
+
+        f = jax.jit(forward, static_argnums=(1, 2))
+        """)
+    assert sorted(codes(r)) == ["JX105", "JX105"]
+    messages = " ".join(f.message for f in r.findings)
+    assert "recompile" in messages and "unhashable" in messages
+
+
+def test_jx105_flags_unhashable_call_site_value(tmp_path):
+    r = lint(tmp_path, "lib/compile.py", """
+        import jax
+
+        def forward(x, mode=None):
+            return x
+
+        f = jax.jit(forward, static_argnames=("mode",))
+        y = f(1.0, mode=[1, 2])
+        """)
+    assert codes(r) == ["JX105"]
+
+
+def test_jx105_passes_hashable_statics(tmp_path):
+    r = lint(tmp_path, "lib/compile.py", """
+        import jax
+
+        def forward(x, mode="train", n=4):
+            return x
+
+        f = jax.jit(forward, static_argnames=("mode", "n"))
+        y = f(1.0, mode="eval", n=8)
+        """)
+    assert codes(r) == []
+
+
+# ----------------------------------------------------------- JX106
+
+
+def test_jx106_flags_print_in_traced_code(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        def fused_op(x):
+            print("x is", x)
+            return x
+        """)
+    assert codes(r) == ["JX106"]
+    assert "jax.debug.print" in r.findings[0].message
+
+
+def test_jx106_passes_debug_print_and_host_prints(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import jax
+
+        def fused_op(x):
+            jax.debug.print("x is {}", x)
+            return x
+        """)
+    assert codes(r) == []
+    r = lint(tmp_path, "lib/host.py", """
+        def epoch_log(metrics):
+            print(metrics)   # host-side logging is fine
+        """)
+    assert codes(r) == []
+
+
+# ----------------------------------------------------------- JX107
+
+
+def test_jx107_flags_jnp_in_data_pipeline(tmp_path):
+    r = lint(tmp_path, "data/pipeline.py", """
+        import jax.numpy as jnp
+
+        def normalize(batch):
+            return jnp.asarray(batch) / 255.0
+        """)
+    # one per offending line: the import and the jnp.asarray use
+    assert codes(r) == ["JX107", "JX107"]
+
+
+def test_jx107_bare_jax_numpy_import_does_not_taint_all_jax(tmp_path):
+    # `import jax.numpy` binds root `jax`; jax.device_put is legitimate
+    # host↔device plumbing in data/ — only the jax.numpy.* use flags
+    r = lint(tmp_path, "data/device.py", """
+        import jax
+        import jax.numpy
+
+        def put(batch, sharding):
+            moved = jax.device_put(batch, sharding)
+            return jax.numpy.asarray(moved)
+        """)
+    assert [(f.code, f.line) for f in r.findings] == [
+        ("JX107", 3), ("JX107", 7)]
+
+
+def test_jx107_passes_numpy_pipeline_and_jnp_elsewhere(tmp_path):
+    r = lint(tmp_path, "data/pipeline.py", """
+        import numpy as np
+
+        def normalize(batch):
+            return np.asarray(batch, np.float32) / 255.0
+        """)
+    assert codes(r) == []
+    r = lint(tmp_path, "lib/ops.py", """
+        import jax.numpy as jnp
+
+        def normalize(batch):
+            return jnp.asarray(batch) / 255.0
+        """)
+    assert codes(r) == []
+
+
+# ----------------------------------------------------------- JX108
+
+
+def test_jx108_flags_unconstrained_reshape(tmp_path):
+    r = lint(tmp_path, "parallel/layout.py", """
+        def regroup(x):
+            y = x.reshape(2, -1)
+            return y
+        """)
+    assert codes(r) == ["JX108"]
+    assert "with_sharding_constraint" in r.findings[0].message
+
+
+def test_jx108_requires_constraint_AFTER_the_layout_change(tmp_path):
+    # a constraint BEFORE the reshape is exactly the hazard: the
+    # re-anchor must follow the layout change
+    r = lint(tmp_path, "parallel/layout.py", """
+        import jax
+
+        def regroup(x, spec):
+            x = jax.lax.with_sharding_constraint(x, spec)
+            y = x.reshape(2, -1)
+            return y
+        """)
+    assert codes(r) == ["JX108"]
+
+
+def test_jx108_passes_constrained_layout_changes(tmp_path):
+    r = lint(tmp_path, "parallel/layout.py", """
+        import jax
+        from deepvision_tpu.parallel.constraint import guard_thin_h
+
+        def regroup(x, spec):
+            y = x.reshape(2, -1)
+            y = jax.lax.with_sharding_constraint(y, spec)
+            return y
+
+        def regroup_direct(x, spec):
+            return jax.lax.with_sharding_constraint(
+                x.transpose(0, 2, 1, 3), spec)
+
+        def regroup_guarded(x):
+            y = x.reshape(x.shape[0], -1, x.shape[-1])
+            return guard_thin_h(y)
+        """)
+    assert codes(r) == []
+
+
+# ------------------------------------------- suppression + baseline
+
+
+def test_inline_suppression_same_line_and_line_above(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        import numpy as np
+
+        def fused_op(x):
+            v = np.asarray(x)  # jaxlint: disable=JX101
+            # jaxlint: disable=JX101
+            w = np.asarray(x)
+            return v, w
+        """)
+    assert codes(r) == []
+    assert r.suppressed == 2
+
+
+def test_file_level_suppression(tmp_path):
+    r = lint(tmp_path, "traced/ops.py", """
+        # jaxlint: disable-file=JX101
+        import numpy as np
+
+        def fused_op(x):
+            return np.asarray(x)
+        """)
+    assert codes(r) == []
+
+
+def test_baseline_suppresses_and_reports_stale(tmp_path):
+    cfg = LintConfig(traced_dirs=["traced"])
+    cfg.baseline = [
+        BaselineEntry(path="traced/ops.py", code="JX101",
+                      match="np.asarray", reason="test fixture"),
+        BaselineEntry(path="traced/gone.py", code="JX103",
+                      reason="stale entry"),
+    ]
+    r = lint(tmp_path, "traced/ops.py", """
+        import numpy as np
+
+        def fused_op(x):
+            return np.asarray(x)
+        """, cfg=cfg)
+    assert codes(r) == []
+    assert r.baselined == 1
+    assert [b.path for b in r.stale_baseline] == ["traced/gone.py"]
+
+
+def test_disabled_checker_is_skipped(tmp_path):
+    cfg = LintConfig(traced_dirs=["traced"], disable=["JX101"])
+    r = lint(tmp_path, "traced/ops.py", """
+        import numpy as np
+
+        def fused_op(x):
+            return np.asarray(x)
+        """, cfg=cfg)
+    assert codes(r) == []
+
+
+# --------------------------------------------------- config parsing
+
+
+def test_minimal_toml_parser_roundtrip():
+    data = loads_toml(textwrap.dedent("""
+        # comment
+        [jaxlint]
+        traced_dirs = ["a/b", "c"]   # trailing comment
+        disable = []
+        threshold = 4
+
+        [[baseline]]
+        path = "x.py"
+        code = "JX103"
+        reason = "it's deliberate, see #7"
+
+        [[baseline]]
+        path = "y.py"
+        code = "JX10*"
+        match = "kdrop"
+        """))
+    assert data["jaxlint"]["traced_dirs"] == ["a/b", "c"]
+    assert data["jaxlint"]["disable"] == []
+    assert data["jaxlint"]["threshold"] == 4
+    assert len(data["baseline"]) == 2
+    assert data["baseline"][0]["reason"] == "it's deliberate, see #7"
+
+
+def test_toml_hash_and_escapes_inside_strings():
+    data = loads_toml(
+        '[t]\n'
+        'a = "issue #12, not a comment"\n'
+        'b = "say \\"hi\\" # still content"   # real comment\n'
+        'c = ["x # y", "z"]\n'
+    )
+    assert data["t"]["a"] == "issue #12, not a comment"
+    assert data["t"]["b"] == 'say "hi" # still content'
+    assert data["t"]["c"] == ["x # y", "z"]
+
+
+def test_load_config_applies_overrides(tmp_path):
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(textwrap.dedent("""
+        [jaxlint]
+        traced_dirs = ["only/this"]
+        disable = ["JX106"]
+
+        [[baseline]]
+        path = "a.py"
+        code = "JX101"
+        reason = "r"
+        """))
+    cfg = load_config(p)
+    assert cfg.traced_dirs == ["only/this"]
+    assert cfg.disable == ["JX106"]
+    assert cfg.baseline[0].code == "JX101"
+    # missing file -> defaults
+    assert load_config(tmp_path / "nope.toml").traced_dirs
+
+
+# ------------------------------------------------------ repo gates
+
+
+def test_repo_is_lint_clean():
+    """The acceptance gate: the static pass exits 0 on the final tree
+    (everything fixed or baselined with a justification)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "deepvision_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_reports_findings_with_exit_1(tmp_path):
+    bad = tmp_path / "models" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text("def loss_fn(x):\n    return x.item()\n")
+    cfg = tmp_path / "jaxlint.toml"
+    cfg.write_text('[jaxlint]\ntraced_dirs = ["models"]\n')
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", str(bad),
+         "--config", str(cfg)],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1
+    assert "JX101" in proc.stdout
+
+
+# -------------------------------------------------------- evalcheck
+
+
+def test_evalcheck_single_model_fast():
+    from tools.jaxlint import evalcheck
+
+    report = evalcheck.check_model("lenet5")
+    assert report["ok"], report.get("error")
+    assert report["outputs"] == [(1, 10)]
+
+
+def test_evalcheck_catches_concretizing_model(monkeypatch):
+    """A model that branches on a traced value must FAIL the gate —
+    the materialization guard is real, not vacuous."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from deepvision_tpu.models import registry
+    from tools.jaxlint import evalcheck
+
+    class Concretizer(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            if jnp.sum(x) > 0:  # ConcretizationTypeError under eval_shape
+                return x
+            return -x
+
+    monkeypatch.setitem(registry._REGISTRY, "_jaxlint_bad",
+                        lambda **kw: Concretizer())
+    monkeypatch.setitem(
+        evalcheck._EXTRA_SPECS, "_jaxlint_bad",
+        evalcheck.ModelSpec((4, 4, 1), init_rngs=("params",),
+                            train_rngs=()),
+    )
+    report = evalcheck.check_model("_jaxlint_bad")
+    assert not report["ok"]
+    assert "Concretization" in report["error"] \
+        or "TracerBoolConversion" in report["error"]
+
+
+def test_evalcheck_catches_batch_mixing_model(monkeypatch):
+    """A reshape folding batch into features must FAIL the gate."""
+    import flax.linen as nn
+
+    from deepvision_tpu.models import registry
+    from tools.jaxlint import evalcheck
+
+    class BatchMixer(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return x.reshape(1, -1)  # batch folded into features
+
+    monkeypatch.setitem(registry._REGISTRY, "_jaxlint_mixer",
+                        lambda **kw: BatchMixer())
+    monkeypatch.setitem(
+        evalcheck._EXTRA_SPECS, "_jaxlint_mixer",
+        evalcheck.ModelSpec((4, 4, 1), init_rngs=("params",),
+                            train_rngs=()),
+    )
+    report = evalcheck.check_model("_jaxlint_mixer")
+    assert not report["ok"]
+    assert "scale with the batch dim" in report["error"]
+
+
+def test_evalcheck_catches_scalar_output_model(monkeypatch):
+    """Reducing the whole batch to a scalar is the extreme batch-mixing
+    case — the scaling gate must not treat 0-d outputs as vacuously ok."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from deepvision_tpu.models import registry
+    from tools.jaxlint import evalcheck
+
+    class Reducer(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return jnp.mean(x)
+
+    monkeypatch.setitem(registry._REGISTRY, "_jaxlint_scalar",
+                        lambda **kw: Reducer())
+    monkeypatch.setitem(
+        evalcheck._EXTRA_SPECS, "_jaxlint_scalar",
+        evalcheck.ModelSpec((4, 4, 1), init_rngs=("params",),
+                            train_rngs=()),
+    )
+    report = evalcheck.check_model("_jaxlint_scalar")
+    assert not report["ok"]
+    assert "scale with the batch dim" in report["error"]
+
+
+def test_evalcheck_full_registry():
+    """The dynamic acceptance gate: every registered model (100% of the
+    registry) traces cleanly under abstract eval."""
+    from tools.jaxlint import evalcheck
+
+    assert evalcheck.run() == 0
+
+
+def test_evalcheck_spec_required_for_new_registry_entries(monkeypatch):
+    from deepvision_tpu.models import registry
+    from tools.jaxlint import evalcheck
+
+    monkeypatch.setitem(registry._REGISTRY, "_jaxlint_specless",
+                        lambda **kw: None)
+    with pytest.raises(KeyError, match="no evalcheck spec"):
+        evalcheck.spec_for("_jaxlint_specless")
+
+
+# ------------------------------------------------------ prng helper
+
+
+def test_keyseq_skip_replays_split_chain():
+    """KeySeq.skip(n) must equal n discarded next() draws — the
+    mid-epoch resume replay contract (trainer.train_epoch)."""
+    import jax
+
+    from deepvision_tpu.core.prng import KeySeq
+
+    a = KeySeq(jax.random.key(7))
+    for _ in range(5):
+        next(a)
+    b = KeySeq(jax.random.key(7)).skip(5)
+    assert jax.random.key_data(next(a)).tolist() == \
+        jax.random.key_data(next(b)).tolist()

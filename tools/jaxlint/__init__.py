@@ -1,0 +1,37 @@
+"""jaxlint — TPU-hazard static analysis for this repo.
+
+The classes of bugs that silently destroy TPU step time — host↔device
+syncs inside jitted code, recompilation hazards, PRNG key reuse, missing
+buffer donation, dropped sharding constraints — are exactly the ones
+pytest does not catch (the program is *correct*, just slow or subtly
+non-reproducible). This package encodes those invariants once, as an
+AST pass every PR runs:
+
+    python -m tools.jaxlint deepvision_tpu/          # static pass
+    python -m tools.jaxlint.evalcheck                # whole-zoo abstract-eval gate
+
+Checker codes (tools/jaxlint/checkers.py):
+
+    JX101  host-sync call (.item()/.tolist()/np.asarray/float()) in traced code
+    JX102  Python if/while on a traced array value (use lax.cond/while_loop)
+    JX103  PRNG key consumed >1 time without an intervening split/fold_in
+    JX104  jitted step function without donate_argnums
+    JX105  unhashable / float Python value in a static jit argument
+    JX106  print() in traced code (use jax.debug.print)
+    JX107  jnp/jax.numpy in a host data pipeline (data/ must stay on host)
+    JX108  reshape/transpose in parallel/ without a sharding constraint
+
+Suppression: append ``# jaxlint: disable=JX103`` to the offending line
+(or the line above), or record a repo-level exception in ``jaxlint.toml``
+with a one-line justification. New checkers subclass
+:class:`tools.jaxlint.core.Checker` and register with
+``@register_checker`` — see README "Static analysis".
+"""
+
+from tools.jaxlint.core import (  # noqa: F401
+    Checker,
+    Finding,
+    LintConfig,
+    register_checker,
+    run_paths,
+)

@@ -1,0 +1,8 @@
+"""CLI entry point: ``python -m tools.jaxlint [paths...]``."""
+
+import sys
+
+from tools.jaxlint.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,695 @@
+"""The initial jaxlint checker set (JX101–JX108).
+
+Each checker targets one class of TPU step-time/correctness hazard that
+pytest cannot see (the program stays *correct* — it just recompiles,
+syncs, or silently correlates PRNG streams). See the package docstring
+for the one-line inventory and README "Static analysis" for how to add
+a checker.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Iterator
+
+from tools.jaxlint.core import (
+    Checker,
+    Finding,
+    FunctionNode,
+    ModuleContext,
+    array_names_in,
+    call_name,
+    dotted_name,
+    last_attr,
+    path_matches_dir,
+    register_checker,
+)
+
+_NP_MATERIALIZERS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_LAYOUT_ATTRS = {"reshape", "transpose", "swapaxes", "moveaxis"}
+
+
+@register_checker
+class HostSyncChecker(Checker):
+    """Host↔device syncs inside traced code: every one serializes the
+    dispatch queue (the device idles while the host waits on a D2H
+    transfer) — the dominant silent step-time regression on TPU."""
+
+    code = "JX101"
+    name = "host-sync-in-trace"
+    description = ("'.item()'/'.tolist()'/np.asarray/float() on a traced "
+                   "value inside jit-reachable code")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        for f in mod.traced_functions():
+            tainted = mod.tainted_names(f.node)
+            for node in ast.walk(f.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _HOST_SYNC_METHODS:
+                    yield mod.finding(
+                        node, self.code,
+                        f"'.{node.func.attr}()' forces a device->host "
+                        "sync inside traced code; keep the value on "
+                        "device (or fetch it outside the step)")
+                    continue
+                name = call_name(node)
+                if name in _NP_MATERIALIZERS:
+                    yield mod.finding(
+                        node, self.code,
+                        f"'{name}' materializes a concrete array inside "
+                        "traced code; use jnp.asarray (trace-safe) or "
+                        "move the conversion to the host pipeline")
+                elif name == "jax.device_get":
+                    yield mod.finding(
+                        node, self.code,
+                        "'jax.device_get' inside traced code is a "
+                        "host sync; fetch results after the step returns")
+                elif name in ("float", "int", "bool") and len(node.args) == 1 \
+                        and mod.expr_is_tainted(node.args[0], tainted):
+                    yield mod.finding(
+                        node, self.code,
+                        f"'{name}()' on a traced value blocks on a "
+                        "device->host transfer; keep it as a jnp scalar "
+                        "(convert on the host after the step)")
+
+
+@register_checker
+class TracedBranchChecker(Checker):
+    """Python ``if``/``while`` on a traced array value: concretizes the
+    tracer (ConcretizationTypeError at best; at worst the branch is
+    burned in at trace time and silently wrong for other inputs)."""
+
+    code = "JX102"
+    name = "python-branch-on-traced"
+    description = ("Python if/while on a traced array value instead of "
+                   "lax.cond/lax.while_loop/jnp.where")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        for f in mod.traced_functions():
+            tainted = mod.tainted_names(f.node)
+            for node in ast.walk(f.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, (
+                        "while" if isinstance(node, ast.While) else "if")
+                elif isinstance(node, ast.IfExp):
+                    test, kind = node.test, "conditional expression"
+                else:
+                    continue
+                if _is_none_check(test):
+                    continue  # 'x is None' resolves statically at trace
+                if mod.expr_is_tainted(test, tainted):
+                    names = sorted({n.id for n in array_names_in(test)
+                                    if n.id in tainted})
+                    what = f" on {', '.join(names)!s}" if names else ""
+                    yield mod.finding(
+                        node, self.code,
+                        f"Python {kind}{what} branches on a traced "
+                        "value; use jax.lax.cond/jax.lax.while_loop "
+                        "(or jnp.where for elementwise selects)")
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+@register_checker
+class KeyReuseChecker(Checker):
+    """PRNG key reuse: the same key consumed by two ``jax.random``-style
+    draws yields *correlated* streams (identical numbers), silently
+    degrading augmentation/dropout/GAN noise. The blessed idioms are
+    ``key, sub = jax.random.split(key)``, ``jax.random.fold_in(key, i)``
+    with distinct data, and ``next(KeySeq)`` (core/prng.py)."""
+
+    code = "JX103"
+    name = "prng-key-reuse"
+    description = ("a PRNG key passed to >=2 consumers without an "
+                   "intervening split/fold_in")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        for f in mod.traced_functions():
+            yield from _KeyScan(mod, f.node).run()
+        # host-side loops thread keys too (epoch loops); scan untraced
+        # functions that visibly handle keys, same rules
+        for info in mod.functions:
+            if mod.is_traced(info.node):
+                continue
+            if info.parent is not None:
+                continue
+            yield from _KeyScan(mod, info.node).run()
+
+
+class _KeyScan:
+    """Flow-sensitive-enough sequential scan of one function:
+
+    - tracks names that look like keys (``key``/``rng``-ish params and
+      anything assigned from split/fold_in/key()/next()/take());
+    - counts consumptions (a tracked name passed to any non-freshener
+      call; indexed subkeys like ``keys[i]`` don't count the base name);
+    - ``split(key)`` itself counts — *using a key after splitting it*
+      is the classic reuse bug — while the canonical
+      ``key, sub = split(key)`` resets the count via its reassignment;
+    - ``fold_in(key, data)`` does NOT count (deriving per-step keys from
+      one base with distinct fold data is the blessed pattern);
+    - loop bodies are scanned twice (models re-entry: a key consumed
+      per-iteration without per-iteration splitting is reuse);
+    - if/else branches are scanned independently and merged by max.
+    """
+
+    def __init__(self, mod: ModuleContext, func: FunctionNode):
+        self.mod = mod
+        self.cfg = mod.cfg
+        self.func = func
+        self.counts: dict[str, int] = {}
+        self.flagged: set[str] = set()
+        self.findings: list[Finding] = []
+        self.fresheners = set(self.cfg.key_fresheners)
+
+    def run(self) -> Iterator[Finding]:
+        args = self.func.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if any(fnmatch.fnmatch(a.arg, p)
+                   for p in self.cfg.key_name_patterns) \
+                    and self._param_is_jax_key(a):
+                self.counts[a.arg] = 0
+        self._stmts(self.func.body)
+        yield from self.findings
+
+    def _param_is_jax_key(self, arg: ast.arg) -> bool:
+        """Evidence that a key-named parameter really is a jax PRNG key
+        (host code passes numpy Generators and torch checkpoint-key
+        STRINGS under the same names):
+
+        - an annotation naming jax/Array/Key types confirms it; any
+          other annotation (str, np.random.Generator) rules it out;
+        - unannotated: yes inside traced code (numpy generators cannot
+          appear there), else only if the body visibly feeds the name
+          to a ``jax.random.*`` call."""
+        if arg.annotation is not None:
+            ann = ast.unparse(arg.annotation)
+            return bool(re.search(r"jax|Array|Key|PRNG", ann))
+        if self.mod.is_traced(self.func):
+            return True
+        for node in ast.walk(self.func):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            if not ("random." in name or name.startswith("random")):
+                continue
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Name) and sub.id == arg.arg:
+                        return True
+        return False
+
+    # -- statement walk -------------------------------------------------
+    def _stmts(self, stmts: list[ast.stmt]) -> None:
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs run (roughly) where they're used; textual
+            # order is the right approximation for closures over keys
+            self._stmts(s.body)
+        elif isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if s.value is not None:
+                self._expr(s.value)
+                self._assign(s, s.value)
+        elif isinstance(s, ast.Expr):
+            self._expr(s.value)
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                self._expr(s.value)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            self._expr(s.iter)
+            for _ in range(2):  # model loop re-entry
+                self._reset_targets(s)
+                self._stmts(s.body)
+            self._stmts(s.orelse)
+        elif isinstance(s, ast.While):
+            for _ in range(2):
+                self._expr(s.test)
+                self._stmts(s.body)
+            self._stmts(s.orelse)
+        elif isinstance(s, ast.If):
+            self._expr(s.test)
+            snap = dict(self.counts)
+            self._stmts(s.body)
+            body_counts = self.counts
+            self.counts = dict(snap)
+            self._stmts(s.orelse)
+            for k in set(body_counts) | set(self.counts):
+                self.counts[k] = max(self.counts.get(k, 0),
+                                     body_counts.get(k, 0))
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                self._expr(item.context_expr)
+            self._stmts(s.body)
+        elif isinstance(s, ast.Try):
+            self._stmts(s.body)
+            for h in s.handlers:
+                self._stmts(h.body)
+            self._stmts(s.orelse)
+            self._stmts(s.finalbody)
+
+    def _reset_targets(self, s: ast.stmt) -> None:
+        from tools.jaxlint.core import assign_target_names
+
+        for name in assign_target_names(s):
+            if name in self.counts:
+                self.counts[name] = 0
+
+    # -- expression walk ------------------------------------------------
+    def _expr(self, e: ast.AST) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self._call(node)
+
+    def _call(self, call: ast.Call) -> None:
+        la = last_attr(call_name(call))
+        if la in self.fresheners and la != "split":
+            return  # fold_in/key()/... derive, they don't consume
+        if la == "next":
+            return  # next(KeySeq) is the blessed stateful idiom
+        if la in ("isinstance", "len", "type", "hasattr", "getattr",
+                  "id", "repr", "str"):
+            return  # static predicates don't consume entropy
+        if la in ("lower", "eval_shape"):
+            return  # AOT lowering/abstract eval read shapes, not entropy
+        for name in self._direct_key_args(call):
+            self.counts[name] = self.counts.get(name, 0) + 1
+            if self.counts[name] >= 2 and name not in self.flagged:
+                self.flagged.add(name)
+                self.findings.append(self.mod.finding(
+                    call, KeyReuseChecker.code,
+                    f"PRNG key '{name}' is consumed more than once "
+                    "without an intervening split/fold_in — the streams "
+                    "are identical; split the key (or use "
+                    "core.prng.KeySeq) before each consumer"))
+
+    def _direct_key_args(self, call: ast.Call) -> list[str]:
+        """Tracked key names used directly in this call's arguments —
+        excluding subtrees owned by nested calls (attributed to the
+        nested call), attribute receivers (``self.x`` uses ``x``, not a
+        key named ``self``), and indexed subkeys (``keys[i]`` is a
+        distinct subkey per index, not a reuse of ``keys``)."""
+        out: list[str] = []
+        skip: set[int] = set()
+        for arg in list(call.args) + [k.value for k in call.keywords]:
+            for node in ast.walk(arg):
+                if id(node) in skip:
+                    continue
+                if isinstance(node, ast.Call):
+                    for sub in ast.walk(node):
+                        if sub is not node:
+                            skip.add(id(sub))
+                elif isinstance(node, (ast.Subscript, ast.Attribute)):
+                    for sub in ast.walk(node):
+                        if sub is not node:
+                            skip.add(id(sub))
+                elif isinstance(node, ast.Name) \
+                        and node.id in self.counts \
+                        and node.id not in out:
+                    out.append(node.id)
+        return out
+
+    def _assign(self, stmt: ast.stmt, value: ast.AST) -> None:
+        from tools.jaxlint.core import assign_target_names
+
+        names = assign_target_names(stmt)
+        if not names:
+            return
+        mints_keys = False
+        if isinstance(value, ast.Call):
+            la = last_attr(call_name(value))
+            if la in self.fresheners or la in ("next", "take"):
+                mints_keys = True
+        elif isinstance(value, ast.Name) and value.id in self.counts:
+            mints_keys = True  # alias of a tracked key
+        for name in names:
+            if name in self.counts or mints_keys:
+                self.counts[name] = 0
+                self.flagged.discard(name)
+            if mints_keys:
+                self.counts.setdefault(name, 0)
+
+
+@register_checker
+class DonateChecker(Checker):
+    """A jitted step that takes the full train state without donating it
+    doubles the parameter+optimizer HBM footprint: XLA must keep the
+    input buffers alive while writing fresh outputs every step."""
+
+    code = "JX104"
+    name = "missing-donate"
+    description = ("jitted step function taking the train state without "
+                   "donate_argnums")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        by_name = {f.node.name: f for f in mod.functions}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and last_attr(call_name(node)) in ("jit", "pjit") \
+                    and node.args:
+                wrapped = node.args[0]
+                if not isinstance(wrapped, ast.Name):
+                    continue  # wrapped expression — can't resolve; skip
+                if self._steplike(wrapped.id, by_name) \
+                        and not self._donates(node):
+                    yield mod.finding(
+                        node, self.code,
+                        f"jitted step function '{wrapped.id}' does not "
+                        "donate its state buffers; pass "
+                        "donate_argnums=(0,) so the optimizer update "
+                        "reuses the parameter HBM in place")
+        for f in mod.functions:
+            for deco in f.node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                is_jit = last_attr(dotted_name(target)) in ("jit", "pjit")
+                # @partial(jax.jit, ...) — donate kwargs live on the
+                # partial call itself
+                if not is_jit and isinstance(deco, ast.Call) \
+                        and last_attr(call_name(deco)) == "partial":
+                    is_jit = any(
+                        last_attr(dotted_name(a)) in ("jit", "pjit")
+                        for a in deco.args)
+                if is_jit \
+                        and self._steplike(f.node.name, by_name) \
+                        and not (isinstance(deco, ast.Call)
+                                 and self._donates(deco)):
+                    yield mod.finding(
+                        deco, self.code,
+                        f"@jit on step function '{f.node.name}' without "
+                        "donate_argnums=(0,): state buffers are copied "
+                        "every step instead of updated in place")
+
+    @staticmethod
+    def _steplike(name: str, by_name: dict) -> bool:
+        if "step" in name.lower():
+            return True
+        f = by_name.get(name)
+        if f is None:
+            return False
+        args = f.node.args.posonlyargs + f.node.args.args
+        return bool(args) and args[0].arg == "state"
+
+    @staticmethod
+    def _donates(call: ast.Call) -> bool:
+        return any(k.arg in ("donate_argnums", "donate_argnames")
+                   for k in call.keywords)
+
+
+@register_checker
+class StaticHazardChecker(Checker):
+    """Recompile hazards through ``static_argnums``/``static_argnames``:
+    a float static recompiles per distinct value (schedules belong in
+    traced args); an unhashable static (list/dict) is a TypeError the
+    first time the call leaves the happy path."""
+
+    code = "JX105"
+    name = "static-arg-hazard"
+    description = ("unhashable or float Python values flowing into "
+                   "static_argnums/static_argnames")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and last_attr(call_name(node)) in ("jit", "pjit"):
+                yield from self._check_jit_call(mod, node, wrapped=(
+                    node.args[0] if node.args else None))
+        for f in mod.functions:
+            for deco in f.node.decorator_list:
+                if isinstance(deco, ast.Call) and last_attr(
+                        call_name(deco)) in ("jit", "pjit"):
+                    yield from self._check_jit_call(
+                        mod, deco, wrapped_def=f.node)
+                # @partial(jax.jit, static_argnums=...) decorator form
+                if isinstance(deco, ast.Call) and last_attr(
+                        call_name(deco)) == "partial" and deco.args \
+                        and last_attr(dotted_name(deco.args[0])) in (
+                            "jit", "pjit"):
+                    yield from self._check_jit_call(
+                        mod, deco, wrapped_def=f.node)
+
+    def _check_jit_call(self, mod: ModuleContext, call: ast.Call,
+                        wrapped: ast.AST | None = None,
+                        wrapped_def: FunctionNode | None = None
+                        ) -> Iterator[Finding]:
+        static_nums = _int_list_kwarg(call, "static_argnums")
+        static_names = _str_list_kwarg(call, "static_argnames")
+        if not static_nums and not static_names:
+            return
+        if wrapped_def is None and isinstance(wrapped, ast.Name):
+            defs = mod.functions_named(wrapped.id)
+            wrapped_def = defs[0].node if defs else None
+        if wrapped_def is not None:
+            yield from self._check_defaults(
+                mod, wrapped_def, static_nums, static_names)
+        # call sites of `F = jax.jit(g, static_argnums=...)`
+        fname = _assigned_name(mod, call)
+        if fname:
+            for site in ast.walk(mod.tree):
+                if isinstance(site, ast.Call) \
+                        and isinstance(site.func, ast.Name) \
+                        and site.func.id == fname:
+                    yield from self._check_site(
+                        mod, site, static_nums, static_names)
+
+    def _check_defaults(self, mod, func, static_nums, static_names
+                        ) -> Iterator[Finding]:
+        args = func.args.posonlyargs + func.args.args
+        defaults = func.args.defaults
+        offset = len(args) - len(defaults)
+        for i, arg in enumerate(args):
+            if i in static_nums or arg.arg in static_names:
+                if i >= offset:
+                    yield from self._judge_value(
+                        mod, defaults[i - offset], arg.arg, "default for")
+        for kwarg, default in zip(func.args.kwonlyargs,
+                                  func.args.kw_defaults):
+            if kwarg.arg in static_names and default is not None:
+                yield from self._judge_value(
+                    mod, default, kwarg.arg, "default for")
+
+    def _check_site(self, mod, site, static_nums, static_names
+                    ) -> Iterator[Finding]:
+        for i, arg in enumerate(site.args):
+            if i in static_nums:
+                yield from self._judge_value(
+                    mod, arg, f"position {i}", "value passed to")
+        for kw in site.keywords:
+            if kw.arg in static_names:
+                yield from self._judge_value(
+                    mod, kw.value, kw.arg, "value passed to")
+
+    def _judge_value(self, mod, node, label, how) -> Iterator[Finding]:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+            yield mod.finding(
+                node, self.code,
+                f"unhashable {how} static arg {label}: jit static "
+                "arguments must be hashable (use a tuple, or make the "
+                "argument traced)")
+        elif isinstance(node, ast.Constant) and isinstance(
+                node.value, float):
+            yield mod.finding(
+                node, self.code,
+                f"float {how} static arg {label}: every distinct value "
+                "triggers a full recompile; pass it as a traced array "
+                "argument instead")
+
+
+def _int_list_kwarg(call: ast.Call, name: str) -> set[int]:
+    for k in call.keywords:
+        if k.arg == name:
+            v = k.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)}
+    return set()
+
+
+def _str_list_kwarg(call: ast.Call, name: str) -> set[str]:
+    for k in call.keywords:
+        if k.arg == name:
+            v = k.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+    return set()
+
+
+def _assigned_name(mod: ModuleContext, call: ast.Call) -> str | None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            if len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name):
+                return node.targets[0].id
+    return None
+
+
+@register_checker
+class PrintChecker(Checker):
+    """``print`` under trace runs ONCE, at trace time, with tracer
+    reprs — it looks like logging but logs nothing at run time."""
+
+    code = "JX106"
+    name = "print-in-trace"
+    description = "print() inside traced code (use jax.debug.print)"
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        for f in mod.traced_functions():
+            for node in ast.walk(f.node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    yield mod.finding(
+                        node, self.code,
+                        "print() inside traced code executes once at "
+                        "trace time with tracer values; use "
+                        "jax.debug.print (or print outside the step)")
+
+
+@register_checker
+class DataJnpChecker(Checker):
+    """``jnp`` in a host data pipeline hijacks device 0 for per-batch
+    preprocessing (and blocks the dispatch queue): ``data/`` is the
+    host-side domain — numpy/tf there, jnp only inside the step."""
+
+    code = "JX107"
+    name = "jnp-in-data-pipeline"
+    description = "jnp/jax.numpy used inside a host data pipeline (data/)"
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        if not path_matches_dir(mod.relpath, mod.cfg.data_dirs):
+            return
+        aliases = {"jnp"}
+        seen_lines: set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.numpy":
+                        # a bare `import jax.numpy` binds root `jax` —
+                        # don't alias-flag every jax.* use (device_put
+                        # in data/ is legitimate host↔device plumbing);
+                        # the dotted `jax.numpy` check below still
+                        # catches the compute uses
+                        if alias.asname:
+                            aliases.add(alias.asname)
+                        yield from self._flag(mod, node, seen_lines)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax" and any(
+                        a.name == "numpy" for a in node.names):
+                    for a in node.names:
+                        if a.name == "numpy":
+                            aliases.add(a.asname or "numpy")
+                    yield from self._flag(mod, node, seen_lines)
+        for node in ast.walk(mod.tree):
+            name = dotted_name(node) if isinstance(
+                node, (ast.Attribute, ast.Name)) else None
+            if name and (name.split(".", 1)[0] in aliases
+                         or name.startswith("jax.numpy")):
+                yield from self._flag(mod, node, seen_lines)
+
+    def _flag(self, mod, node, seen_lines) -> Iterator[Finding]:
+        line = getattr(node, "lineno", 0)
+        if line in seen_lines:
+            return
+        seen_lines.add(line)
+        yield mod.finding(
+            node, self.code,
+            "jnp compute inside a host data pipeline runs on (and "
+            "blocks) device 0 per batch; keep data/ on numpy/tf and do "
+            "device math inside the compiled step")
+
+
+@register_checker
+class ConstraintChecker(Checker):
+    """Layout changes in ``parallel/`` that aren't re-anchored with a
+    sharding constraint: GSPMD propagates *a* sharding through
+    reshape/transpose, but not necessarily the intended one — the
+    classic source of silent all-gathers at scale."""
+
+    code = "JX108"
+    name = "unconstrained-layout-change"
+    description = ("reshape/transpose in parallel/ not followed by "
+                   "with_sharding_constraint/guard_thin_h")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        if not path_matches_dir(mod.relpath, mod.cfg.parallel_dirs):
+            return
+        constraint = set(mod.cfg.constraint_funcs)
+        for info in mod.functions:
+            if info.parent is not None:
+                continue
+            # (name, lineno) of every constraint-call argument: only a
+            # constraint at-or-after the layout change re-anchors it —
+            # one BEFORE the reshape is exactly the hazard
+            constrained: list[tuple[str, int]] = []
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call) \
+                        and last_attr(call_name(node)) in constraint:
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Name):
+                                constrained.append(
+                                    (sub.id, node.lineno))
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.stmt):
+                    continue
+                value = getattr(node, "value", None)
+                if not (isinstance(value, ast.Call)
+                        and self._is_layout_call(value)):
+                    continue
+                if self._directly_constrained(info.node, value,
+                                              constraint):
+                    continue
+                names = (ast.unparse(value.func) if hasattr(
+                    ast, "unparse") else "call")
+                targets = [n for n in self._targets(node)]
+                if targets and any(
+                        t == c and line >= value.lineno
+                        for t in targets for c, line in constrained):
+                    continue
+                yield mod.finding(
+                    value, self.code,
+                    f"'{names}' changes layout in parallel code without "
+                    "a following with_sharding_constraint/guard_thin_h; "
+                    "re-anchor the sharding or GSPMD may silently "
+                    "all-gather")
+
+    @staticmethod
+    def _is_layout_call(call: ast.Call) -> bool:
+        la = last_attr(call_name(call))
+        return la in _LAYOUT_ATTRS
+
+    @staticmethod
+    def _targets(stmt: ast.stmt) -> list[str]:
+        from tools.jaxlint.core import assign_target_names
+
+        return assign_target_names(stmt)
+
+    @staticmethod
+    def _directly_constrained(func: FunctionNode, call: ast.Call,
+                              constraint: set[str]) -> bool:
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call) \
+                    and last_attr(call_name(node)) in constraint:
+                for sub in ast.walk(node):
+                    if sub is call:
+                        return True
+        return False

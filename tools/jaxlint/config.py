@@ -1,0 +1,270 @@
+"""jaxlint configuration: ``jaxlint.toml`` loading + the LintConfig model.
+
+The repo's Python is 3.10 (no stdlib ``tomllib``) and the container's
+dependency set is frozen, so this module carries a deliberately minimal
+TOML-subset reader covering exactly what ``jaxlint.toml`` uses: comments,
+``[table]`` / ``[[array-of-tables]]`` headers (dotted keys allowed),
+and ``key = value`` with string / number / bool / list-of-scalars values
+(lists may span lines). Anything fancier (inline tables, dates, escapes
+beyond ``\\"`` and ``\\\\``) is rejected loudly rather than misread.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# --------------------------------------------------------------- TOML subset
+
+
+class TomlError(ValueError):
+    pass
+
+
+_BARE_KEY = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+
+def _parse_scalar(tok: str, where: str):
+    tok = tok.strip()
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        body = tok[1:-1]
+        # the only escapes jaxlint.toml needs
+        return body.replace('\\"', '"').replace("\\\\", "\\")
+    if tok.startswith("'") and tok.endswith("'") and len(tok) >= 2:
+        return tok[1:-1]
+    if tok in ("true", "false"):
+        return tok == "true"
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        raise TomlError(f"{where}: unsupported TOML value {tok!r}") from None
+
+
+def _split_list_items(body: str, where: str) -> list[str]:
+    """Split a [...] body on commas that are outside quotes
+    (backslash-escape aware within basic strings)."""
+    items, cur, quote, escaped = [], "", None, False
+    for ch in body:
+        if quote:
+            cur += ch
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote == '"':
+                escaped = True
+            elif ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            cur += ch
+        elif ch == ",":
+            items.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if quote:
+        raise TomlError(f"{where}: unterminated string in list")
+    items.append(cur)
+    return [i.strip() for i in items if i.strip()]
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing comment; '#' inside quotes (incl. after an
+    escaped quote like ``"a \\" # b"``) is content, not a comment."""
+    quote, escaped = None, False
+    for i, ch in enumerate(line):
+        if quote:
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote == '"':
+                escaped = True
+            elif ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
+def loads_toml(text: str) -> dict:
+    """Parse the TOML subset described in the module docstring."""
+    root: dict = {}
+    current = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        raw = _strip_comment(lines[i]).strip()
+        i += 1
+        if not raw:
+            continue
+        where = f"line {i}"
+        if raw.startswith("[["):  # array of tables
+            if not raw.endswith("]]"):
+                raise TomlError(f"{where}: malformed table header {raw!r}")
+            name = raw[2:-2].strip()
+            parent = _descend(root, name, where)
+            arr = parent.setdefault(name.split(".")[-1], [])
+            if not isinstance(arr, list):
+                raise TomlError(f"{where}: {name!r} redefined as an array")
+            current = {}
+            arr.append(current)
+        elif raw.startswith("["):
+            if not raw.endswith("]"):
+                raise TomlError(f"{where}: malformed table header {raw!r}")
+            name = raw[1:-1].strip()
+            parent = _descend(root, name, where)
+            current = parent.setdefault(name.split(".")[-1], {})
+            if not isinstance(current, dict):
+                raise TomlError(f"{where}: {name!r} redefined as a table")
+        else:
+            if "=" not in raw:
+                raise TomlError(f"{where}: expected key = value, got {raw!r}")
+            key, _, val = raw.partition("=")
+            key, val = key.strip(), val.strip()
+            if not _BARE_KEY.match(key):
+                raise TomlError(f"{where}: unsupported key {key!r}")
+            if val.startswith("["):
+                # accumulate a possibly multiline list
+                while val.count("[") > val.count("]"):
+                    if i >= len(lines):
+                        raise TomlError(f"{where}: unterminated list")
+                    val += " " + _strip_comment(lines[i]).strip()
+                    i += 1
+                body = val.strip()[1:-1]
+                current[key] = [
+                    _parse_scalar(t, where)
+                    for t in _split_list_items(body, where)
+                ]
+            else:
+                current[key] = _parse_scalar(val, where)
+    return root
+
+
+def _descend(root: dict, dotted: str, where: str) -> dict:
+    node = root
+    parts = dotted.split(".")
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+        if not isinstance(node, dict):
+            raise TomlError(f"{where}: {part!r} is not a table")
+    return node
+
+
+# ------------------------------------------------------------- LintConfig
+
+
+@dataclass
+class BaselineEntry:
+    """A recorded, justified exception: findings matching (path, code[,
+    match-substring]) are suppressed. ``reason`` is mandatory by
+    convention so the debt ledger stays reviewable."""
+
+    path: str
+    code: str
+    reason: str = ""
+    match: str = ""
+    hits: int = 0  # filled by the engine; stale entries are warned about
+
+    def matches(self, path: str, code: str, text: str) -> bool:
+        return (
+            self.path == path
+            and fnmatch.fnmatch(code, self.code)
+            and (not self.match or self.match in text)
+        )
+
+
+@dataclass
+class LintConfig:
+    """Knobs for the checkers; defaults encode this repo's layout and are
+    overridable from ``jaxlint.toml`` (``[jaxlint]`` table)."""
+
+    # Directories whose every function is traced-by-construction (the
+    # README contract: models/ops/losses are pure jit-able code).
+    traced_dirs: list[str] = field(default_factory=lambda: [
+        "deepvision_tpu/models", "deepvision_tpu/ops",
+        "deepvision_tpu/losses",
+    ])
+    # Host-side data pipelines: jnp compute is a hazard here (JX107).
+    data_dirs: list[str] = field(default_factory=lambda: [
+        "deepvision_tpu/data",
+    ])
+    # Sharding-sensitive layout code: reshape/transpose must be followed
+    # by a sharding constraint (JX108).
+    parallel_dirs: list[str] = field(default_factory=lambda: [
+        "deepvision_tpu/parallel",
+    ])
+    # Function-name patterns treated as traced even outside traced_dirs
+    # (the step-function naming contract of train/steps.py, train/gan.py).
+    traced_name_patterns: list[str] = field(default_factory=lambda: [
+        "*_train_step", "*_eval_step", "*_loss_fn", "loss_fn",
+        "*_step_fn",
+    ])
+    # Callables that trace their function argument: a function passed to
+    # (or decorated by) one of these is traced, and its same-module
+    # callees transitively so.
+    jit_wrappers: list[str] = field(default_factory=lambda: [
+        "jit", "pjit", "eval_shape", "grad", "value_and_grad", "vmap",
+        "pmap", "shard_map", "checkify", "scan", "cond", "while_loop",
+        "fori_loop", "switch", "remat", "checkpoint", "custom_vjp",
+        "custom_jvp", "compile_train_step", "compile_eval_step",
+        "compile_checked_train_step",
+    ])
+    # jax/lax calls that return *static* Python values — safe in Python
+    # control flow, never a taint source (JX101/JX102).
+    static_return_calls: list[str] = field(default_factory=lambda: [
+        "axis_size", "process_index", "process_count", "device_count",
+        "local_device_count", "default_backend", "devices",
+        "local_devices",
+    ])
+    # jax.random.* that mint fresh keys rather than consuming entropy.
+    key_fresheners: list[str] = field(default_factory=lambda: [
+        "split", "fold_in", "key", "PRNGKey", "key_data",
+        "wrap_key_data", "clone",
+    ])
+    # Parameter-name patterns tracked as PRNG keys (JX103); names
+    # assigned from split()/fold_in()/next(KeySeq) are tracked regardless.
+    key_name_patterns: list[str] = field(default_factory=lambda: [
+        "key", "rng", "*_key", "*_rng", "key_*", "rng_*", "seed_key",
+    ])
+    # Blessed sharding-constraint sinks for JX108.
+    constraint_funcs: list[str] = field(default_factory=lambda: [
+        "with_sharding_constraint", "guard_thin_h",
+    ])
+    disable: list[str] = field(default_factory=list)
+    baseline: list[BaselineEntry] = field(default_factory=list)
+
+
+def load_config(path: str | Path | None) -> LintConfig:
+    """Build a LintConfig from ``jaxlint.toml`` (or defaults if absent)."""
+    cfg = LintConfig()
+    if path is None:
+        return cfg
+    path = Path(path)
+    if not path.exists():
+        return cfg
+    data = loads_toml(path.read_text())
+    table = data.get("jaxlint", {})
+    for name in (
+        "traced_dirs", "data_dirs", "parallel_dirs",
+        "traced_name_patterns", "jit_wrappers", "static_return_calls",
+        "key_fresheners", "key_name_patterns", "constraint_funcs",
+        "disable",
+    ):
+        if name in table:
+            setattr(cfg, name, list(table[name]))
+    for entry in data.get("baseline", []):
+        if "path" not in entry or "code" not in entry:
+            raise TomlError(
+                "baseline entries need at least 'path' and 'code': "
+                f"{entry!r}")
+        cfg.baseline.append(BaselineEntry(
+            path=entry["path"], code=entry["code"],
+            reason=entry.get("reason", ""), match=entry.get("match", ""),
+        ))
+    return cfg

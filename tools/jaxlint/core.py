@@ -1,0 +1,492 @@
+"""jaxlint engine: module model, traced-function analysis, checker API.
+
+The engine parses each file once into a :class:`ModuleContext` carrying
+the shared analyses every checker needs:
+
+- **traced set** — which functions end up inside an XLA trace. Seeds:
+  functions in ``traced_dirs`` (models/ops/losses are pure jit-able code
+  by repo contract), functions decorated by or passed to a jit wrapper
+  (``jax.jit``/``pjit``/``value_and_grad``/``lax.scan``/
+  ``compile_train_step``…), and functions matching the step-function
+  naming contract. Closure: nested defs of traced functions and
+  same-module callees, to a fixpoint.
+- **taint** — per-function set of names holding (likely) traced arrays:
+  assigned from a ``jnp.*``/``jax.lax.*``/``jax.random.*`` call, or
+  derived from a tainted name. ``.shape``/``.ndim``/``.dtype``/``.size``
+  reads and static-returning jax calls (``axis_size`` …) are shields —
+  branching on those is trace-safe.
+
+Checkers subclass :class:`Checker`, register with ``@register_checker``,
+and yield :class:`Finding`s; the engine applies inline
+``# jaxlint: disable=CODE`` suppressions and the ``jaxlint.toml``
+baseline, then reports ``file:line CODE message``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from tools.jaxlint.config import BaselineEntry, LintConfig, load_config
+
+__all__ = [
+    "Checker", "Finding", "LintConfig", "ModuleContext",
+    "register_checker", "run_paths",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # posix relpath from the lint root
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.code} {self.message}"
+
+
+# ----------------------------------------------------------- AST helpers
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.random.split' for Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def last_attr(name: str | None) -> str | None:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+_JAX_ROOTS = {"jnp", "jax", "lax", "random", "nn"}
+
+# attribute reads that yield static Python values off a traced array
+_SHIELD_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+# predicate builtins whose arguments resolve statically at trace time
+_SHIELD_CALLS = {"isinstance", "len", "hasattr", "getattr", "type"}
+
+
+def is_jax_array_call(call: ast.Call, cfg: LintConfig) -> bool:
+    """True for calls that (likely) return a traced array: any call
+    rooted at jnp/jax/lax that is not on the static-return allowlist."""
+    name = call_name(call)
+    if not name:
+        return False
+    root = name.split(".", 1)[0]
+    if root not in _JAX_ROOTS:
+        return False
+    return last_attr(name) not in set(cfg.static_return_calls)
+
+
+def array_names_in(expr: ast.AST) -> Iterator[ast.Name]:
+    """Name loads in ``expr`` that could carry array values: skips names
+    under shield attributes (``x.shape``…), shield builtin calls
+    (``isinstance(x, …)``), and call-function positions."""
+    skip: set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _SHIELD_ATTRS:
+            for sub in ast.walk(node.value):
+                skip.add(id(sub))
+        elif isinstance(node, ast.Call):
+            fn = last_attr(call_name(node))
+            for sub in ast.walk(node.func):
+                skip.add(id(sub))
+            if fn in _SHIELD_CALLS:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    for sub in ast.walk(arg):
+                        skip.add(id(sub))
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and id(node) not in skip:
+            yield node
+
+
+def assign_target_names(stmt: ast.stmt) -> list[str]:
+    """Flat names BOUND by an Assign/AnnAssign/AugAssign/for-target.
+    Only Store-context Names count: ``self._key, sub = ...`` binds
+    ``sub``, not ``self`` (the attribute's receiver is a Load)."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    out: list[str] = []
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Store):
+                out.append(node.id)
+    return out
+
+
+def path_matches_dir(relpath: str, dirs: Iterable[str]) -> bool:
+    """Segment-bounded containment: 'deepvision_tpu/data' matches files
+    anywhere under that directory (builders/ included)."""
+    probe = "/" + relpath
+    return any(f"/{d.strip('/')}/" in probe for d in dirs)
+
+
+# ------------------------------------------------------------ module model
+
+
+FunctionNode = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+@dataclass
+class FunctionInfo:
+    node: FunctionNode
+    qualname: str
+    parent: "FunctionInfo | None" = None
+
+
+class ModuleContext:
+    """One parsed file + the shared analyses checkers consume."""
+
+    def __init__(self, path: Path, relpath: str, source: str,
+                 cfg: LintConfig):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.cfg = cfg
+        self.tree = ast.parse(source, filename=str(path))
+        self.functions: list[FunctionInfo] = []
+        self._collect_functions(self.tree, None, [])
+        self._traced_ids: set[int] = self._compute_traced()
+        self._taint_cache: dict[int, set[str]] = {}
+
+    # -- function table ------------------------------------------------
+    def _collect_functions(self, node: ast.AST, parent: FunctionInfo | None,
+                           prefix: list[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FunctionInfo(
+                    child, ".".join(prefix + [child.name]), parent
+                )
+                self.functions.append(info)
+                self._collect_functions(child, info, prefix + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                self._collect_functions(child, parent,
+                                        prefix + [child.name])
+            else:
+                self._collect_functions(child, parent, prefix)
+
+    def functions_named(self, name: str) -> list[FunctionInfo]:
+        return [f for f in self.functions if f.node.name == name]
+
+    # -- traced analysis -----------------------------------------------
+    def _compute_traced(self) -> set[int]:
+        cfg = self.cfg
+        traced: set[int] = set()
+        if path_matches_dir(self.relpath, cfg.traced_dirs):
+            return {id(f.node) for f in self.functions}
+        wrappers = set(cfg.jit_wrappers)
+        by_name: dict[str, list[FunctionInfo]] = {}
+        for f in self.functions:
+            by_name.setdefault(f.node.name, []).append(f)
+            # seed: naming contract
+            if any(fnmatch.fnmatch(f.node.name, p)
+                   for p in cfg.traced_name_patterns):
+                traced.add(id(f.node))
+            # seed: @jax.jit / @partial(jax.jit, ...) decorators
+            for deco in f.node.decorator_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                if last_attr(dotted_name(target)) in wrappers:
+                    traced.add(id(f.node))
+                if (isinstance(deco, ast.Call)
+                        and last_attr(call_name(deco)) == "partial"):
+                    for arg in deco.args:
+                        if last_attr(dotted_name(arg)) in wrappers:
+                            traced.add(id(f.node))
+        # seed: functions passed by name into a jit wrapper call
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_attr(call_name(node)) not in wrappers:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    for f in by_name.get(arg.id, []):
+                        traced.add(id(f.node))
+        # closure: nested defs + same-module callees, to a fixpoint
+        changed = True
+        while changed:
+            changed = False
+            for f in self.functions:
+                if f.parent and id(f.parent.node) in traced \
+                        and id(f.node) not in traced:
+                    traced.add(id(f.node))
+                    changed = True
+            for f in self.functions:
+                if id(f.node) not in traced:
+                    continue
+                for node in ast.walk(f.node):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name):
+                        for g in by_name.get(node.func.id, []):
+                            if id(g.node) not in traced:
+                                traced.add(id(g.node))
+                                changed = True
+        return traced
+
+    def is_traced(self, func: FunctionNode) -> bool:
+        return id(func) in self._traced_ids
+
+    def traced_functions(self) -> list[FunctionInfo]:
+        """Outermost-first traced functions; nested defs of a traced
+        function are NOT re-listed (walk the parent instead), so
+        checkers that scan whole bodies don't double-report."""
+        out = []
+        for f in self.functions:
+            if not self.is_traced(f.node):
+                continue
+            if f.parent is not None and self.is_traced(f.parent.node):
+                continue
+            out.append(f)
+        return out
+
+    # -- taint analysis ------------------------------------------------
+    def tainted_names(self, func: FunctionNode) -> set[str]:
+        """Names in ``func`` (nested defs included) plausibly bound to
+        traced arrays: assigned from a jnp/jax/lax array call or derived
+        from an already-tainted name. Parameters are NOT tainted (too
+        noisy: static config ints flow through the same signatures)."""
+        if id(func) in self._taint_cache:
+            return self._taint_cache[id(func)]
+        assigns: list[tuple[list[str], ast.AST]] = []
+        for node in ast.walk(func):
+            names = assign_target_names(node) if isinstance(node, (
+                ast.Assign, ast.AnnAssign, ast.AugAssign)) else []
+            value = getattr(node, "value", None)
+            if names and value is not None:
+                assigns.append((names, value))
+        tainted: set[str] = set()
+        for _ in range(3):  # fixpoint; 3 passes cover real chains
+            before = len(tainted)
+            for names, value in assigns:
+                if self.expr_is_tainted(value, tainted):
+                    tainted.update(names)
+            if len(tainted) == before:
+                break
+        self._taint_cache[id(func)] = tainted
+        return tainted
+
+    def expr_is_tainted(self, expr: ast.AST, tainted: set[str]) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) \
+                    and is_jax_array_call(node, self.cfg):
+                return True
+        return any(n.id in tainted for n in array_names_in(expr))
+
+    # -- reporting -----------------------------------------------------
+    def finding(self, node: ast.AST, code: str, message: str) -> Finding:
+        return Finding(self.relpath, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), code, message)
+
+
+# ------------------------------------------------------------ checker API
+
+
+class Checker:
+    """Plugin base: set ``code``/``name``/``description``, implement
+    ``check(module) -> Iterator[Finding]``, decorate with
+    ``@register_checker``. One instance lints many modules."""
+
+    code: str = "JX000"
+    name: str = "abstract"
+    description: str = ""
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+CHECKERS: dict[str, Checker] = {}
+
+
+def register_checker(cls: type[Checker]) -> type[Checker]:
+    if cls.code in CHECKERS:
+        raise ValueError(f"duplicate checker code {cls.code}")
+    CHECKERS[cls.code] = cls()
+    return cls
+
+
+# ------------------------------------------------------------- suppression
+
+
+_DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Z0-9,\s]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*jaxlint:\s*disable-file=([A-Z0-9,\s]+)")
+
+
+def _inline_suppressions(lines: list[str]) -> tuple[dict[int, set[str]],
+                                                    set[str]]:
+    """(per-line disabled codes, whole-file disabled codes). A disable
+    comment covers its own line and the line below it (so long
+    expressions can carry the pragma above)."""
+    per_line: dict[int, set[str]] = {}
+    file_wide: set[str] = set()
+    for i, line in enumerate(lines, start=1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            per_line.setdefault(i, set()).update(codes)
+            per_line.setdefault(i + 1, set()).update(codes)
+        m = _DISABLE_FILE_RE.search(line)
+        if m and i <= 10:
+            file_wide.update(
+                c.strip() for c in m.group(1).split(",") if c.strip())
+    return per_line, file_wide
+
+
+# ---------------------------------------------------------------- engine
+
+
+def iter_python_files(paths: Iterable[str | Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    baselined: int = 0
+    errors: list[str] = field(default_factory=list)
+    stale_baseline: list[BaselineEntry] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def run_paths(paths: Iterable[str | Path], cfg: LintConfig | None = None,
+              *, root: str | Path | None = None,
+              select: Iterable[str] | None = None,
+              use_baseline: bool = True) -> LintResult:
+    """Lint ``paths`` (files or directories). Relpaths in findings are
+    relative to ``root`` (default: cwd). ``select`` restricts to the
+    given checker codes."""
+    # import for registration side effects (mirrors models/__init__.py)
+    import tools.jaxlint.checkers  # noqa: F401
+
+    cfg = cfg or LintConfig()
+    root = Path(root) if root is not None else Path.cwd()
+    active = [
+        c for code, c in sorted(CHECKERS.items())
+        if code not in set(cfg.disable)
+        and (select is None or code in set(select))
+    ]
+    result = LintResult()
+    for path in iter_python_files(paths):
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        try:
+            source = path.read_text()
+            mod = ModuleContext(path, rel, source, cfg)
+        except (OSError, SyntaxError, ValueError) as e:
+            result.errors.append(f"{rel}: unparseable: {e}")
+            continue
+        per_line, file_wide = _inline_suppressions(mod.lines)
+        for checker in active:
+            for f in checker.check(mod):
+                if f.code in file_wide or f.code in per_line.get(
+                        f.line, ()):
+                    result.suppressed += 1
+                    continue
+                src_line = (mod.lines[f.line - 1]
+                            if 0 < f.line <= len(mod.lines) else "")
+                entry = _baseline_match(cfg, f, src_line) \
+                    if use_baseline else None
+                if entry is not None:
+                    entry.hits += 1
+                    result.baselined += 1
+                    continue
+                result.findings.append(f)
+    if use_baseline:
+        result.stale_baseline = [b for b in cfg.baseline if b.hits == 0]
+    result.findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return result
+
+
+def _baseline_match(cfg: LintConfig, f: Finding,
+                    src_line: str) -> BaselineEntry | None:
+    for entry in cfg.baseline:
+        if entry.matches(f.path, f.code, f.message + "\n" + src_line):
+            return entry
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="TPU-hazard static analysis (see tools/jaxlint/).",
+    )
+    parser.add_argument("paths", nargs="*", default=["deepvision_tpu"],
+                        help="files or directories (default: deepvision_tpu)")
+    parser.add_argument("--config", default="jaxlint.toml",
+                        help="config file (default: ./jaxlint.toml)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated checker codes to run")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the jaxlint.toml baseline")
+    parser.add_argument("--list-checkers", action="store_true")
+    parser.add_argument("--statistics", action="store_true",
+                        help="print per-code counts and suppression totals")
+    args = parser.parse_args(argv)
+
+    import tools.jaxlint.checkers  # noqa: F401  (registration)
+
+    if args.list_checkers:
+        for code, c in sorted(CHECKERS.items()):
+            print(f"{code}  {c.name:24s} {c.description}")
+        return 0
+
+    cfg = load_config(args.config)
+    select = (
+        [c.strip() for c in args.select.split(",")] if args.select else None
+    )
+    result = run_paths(args.paths, cfg, select=select,
+                       use_baseline=not args.no_baseline)
+    for err in result.errors:
+        print(f"ERROR {err}", file=sys.stderr)
+    for f in result.findings:
+        print(f.render())
+    for b in result.stale_baseline:
+        print(f"warning: stale baseline entry {b.path} {b.code} "
+              f"({b.reason or 'no reason recorded'}) matched nothing",
+              file=sys.stderr)
+    if args.statistics:
+        counts: dict[str, int] = {}
+        for f in result.findings:
+            counts[f.code] = counts.get(f.code, 0) + 1
+        for code, n in sorted(counts.items()):
+            print(f"{code}: {n}", file=sys.stderr)
+        print(f"{len(result.findings)} finding(s), "
+              f"{result.suppressed} inline-suppressed, "
+              f"{result.baselined} baselined", file=sys.stderr)
+    return 0 if result.ok else 1
