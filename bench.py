@@ -45,6 +45,12 @@ PIPELINE_IMAGES = 4096  # synthetic TFRecord set size for the fed bench
 # the median robust to one outlier rep per path, and the spread is
 # reported against the median, not min-max of 3.
 FED_WARMUP, FED_STEPS, FED_REPEATS = 3, 12, 5
+# warmup/pacing: each rep builds a FRESH tf.data pipeline, so its first
+# next() pays the full shuffle-buffer fill + tf autotune ramp — the
+# 408.7 → 338.1 per-rep swing in r4's pipeline_fed_rates was this skew,
+# not steady-state jitter. Discard FED_DISCARD host batches before the
+# measured region so every rep starts from a filled, paced pipeline.
+FED_DISCARD = 4
 
 # Peak bf16 FLOP/s by device kind (public spec sheets); unknown kinds
 # fall back to 100 TF/s so MFU is at least order-of-magnitude meaningful.
@@ -58,15 +64,25 @@ PEAK_FLOPS = {
 }
 
 
+def _cost_analysis(compiled) -> dict:
+    """Compiled-executable cost analysis as one flat dict across jax
+    versions — newer jax returns a dict, older (0.4.x) a list with one
+    per-device dict; {} when unavailable."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
 def _flops_per_step(compiled) -> float | None:
     """XLA's own FLOP count for one compiled step (per-device: cost
     analysis runs on the post-SPMD-partitioned executable); None if
     unavailable."""
-    try:
-        flops = float(compiled.cost_analysis().get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
-        return None
+    flops = float(_cost_analysis(compiled).get("flops", 0.0))
+    return flops if flops > 0 else None
 
 
 def _write_synthetic_tfrecords(root: Path, n: int) -> None:
@@ -210,7 +226,7 @@ def main() -> None:
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_gb_per_step": (
-            round(float(compiled.cost_analysis().get("bytes accessed", 0))
+            round(float(_cost_analysis(compiled).get("bytes accessed", 0))
                   / 1e9, 1)
         ),
         "device_kind": kind,
@@ -367,7 +383,7 @@ def _zoo_bench(mesh, n_chips, kind, peak_bf16,
             db = shard_batch(mesh, batch)
             key = jax.random.key(0)
             compiled = step.lower(state, db, key).compile()
-            ca = compiled.cost_analysis()
+            ca = _cost_analysis(compiled)
             flops, bytes_ = float(ca.get("flops", 0)), float(
                 ca.get("bytes accessed", 0))
             # sync via a scalar FETCH from the updated params:
@@ -421,39 +437,74 @@ def _median_spread(vals):
     return round(med, 1), round(spread, 1)
 
 
+def _tel_median(summaries):
+    """Median of each per-stage telemetry field across fed reps."""
+    keys = ("host_wait_ms", "shard_ms", "h2d_wait_ms", "step_ms",
+            "input_wait_frac")
+    return {k: round(float(np.median([s[k] for s in summaries])), 3)
+            for k in keys}
+
+
 def _run_fed_once(state, step, mesh, key, batch_size, n_chips, make_ds,
                   seed):
     """One fed-throughput repetition for one dataset factory.
 
-    Returns ``(rate, state)`` — the step donates its input state, so the
-    caller MUST thread the returned state into any further step calls
-    (reusing the donated original raises InvalidArgument)."""
-    from deepvision_tpu.data.device_put import device_prefetch
+    Returns ``(rate, state, telemetry)`` — the step donates its input
+    state, so the caller MUST thread the returned state into any further
+    step calls (reusing the donated original raises InvalidArgument);
+    ``telemetry`` is the steady-state ``FeedTelemetry.summary()`` of the
+    measured steps (host-wait / H2D-wait / step-compute split)."""
+    from deepvision_tpu.data.prefetch import DevicePrefetcher, FeedTelemetry
 
     ds = make_ds(seed=seed)
     it = ds.as_numpy_iterator()
+    # pacing: exclude the fresh pipeline's shuffle-buffer fill / autotune
+    # ramp from the measurement (see FED_DISCARD)
+    for _ in range(FED_DISCARD):
+        next(it)
 
     def host_batches():
         for _ in range(FED_WARMUP + FED_STEPS):
             img, lbl = next(it)
             yield {"image": img, "label": lbl}
 
-    t0 = None
-    for i, dbatch in enumerate(device_prefetch(host_batches(), mesh)):
-        if i == FED_WARMUP:
-            float(state.params["fc"]["bias"][0])  # drain warmup
-            t0 = time.perf_counter()
-        key, sub = jax.random.split(key)
-        state, _ = step(state, dbatch, sub)
-    float(state.params["fc"]["bias"][0])
-    dt = time.perf_counter() - t0
-    return FED_STEPS * batch_size / dt / n_chips, state
+    # async feed (data/prefetch.py): producer-thread sharding keeps the
+    # H2D transfers in flight ahead of the running step — the measured
+    # configuration IS the training configuration
+    tel = FeedTelemetry()
+    feed = DevicePrefetcher(host_batches(), mesh, telemetry=tel)
+    t0, base = None, None
+    try:
+        for i, dbatch in enumerate(feed):
+            if i == FED_WARMUP:
+                float(state.params["fc"]["bias"][0])  # drain warmup
+                # steady-state telemetry scope: snapshot-delta (not
+                # reset — a live producer's += races a reset write),
+                # and restart the step clock so the warmup drain above
+                # is not charged to the first measured step interval
+                feed.restart_clock()
+                base = tel.snapshot()
+                t0 = time.perf_counter()
+            key, sub = jax.random.split(key)
+            state, _ = step(state, dbatch, sub)
+        float(state.params["fc"]["bias"][0])
+        dt = time.perf_counter() - t0
+    finally:
+        feed.close()
+    # batches=FED_STEPS: exactly FED_STEPS step/H2D intervals land after
+    # the snapshot (the boundary batch's fetch preceded it), so pin the
+    # divisor to the true measured-step count
+    return (FED_STEPS * batch_size / dt / n_chips, state,
+            tel.summary(since=base, batches=FED_STEPS))
 
 
 def _host_only_rate(ds, n_batches, batch_size):
-    """Pure tf.data drain — the host ceiling, no device in the loop."""
+    """Pure tf.data drain — the host ceiling, no device in the loop.
+    Discards the same FED_DISCARD ramp batches as the fed reps so the
+    ceiling and the fed rates compare steady state to steady state."""
     it = ds.as_numpy_iterator()
-    next(it)  # pipeline warm-up
+    for _ in range(FED_DISCARD):  # shuffle-buffer fill / autotune ramp
+        next(it)
     t0 = time.perf_counter()
     for _ in range(n_batches):
         next(it)
@@ -496,13 +547,16 @@ def _pipeline_benches(state, step, mesh, key, batch_size, n_chips) -> dict:
     # comparison difference-in-pairs honest, and the per-rep rates are
     # reported raw so drift is visible instead of folded into a median.
     jpeg_rates, raw_rates = [], []
+    jpeg_tel, raw_tel = [], []
     for rep in range(FED_REPEATS):
-        r, state = _run_fed_once(state, step, mesh, key, batch_size,
-                                 n_chips, jpeg_ds, seed=rep)
+        r, state, t = _run_fed_once(state, step, mesh, key, batch_size,
+                                    n_chips, jpeg_ds, seed=rep)
         jpeg_rates.append(r)
-        r, state = _run_fed_once(state, step, mesh, key, batch_size,
-                                 n_chips, raw_ds, seed=rep)
+        jpeg_tel.append(t)
+        r, state, t = _run_fed_once(state, step, mesh, key, batch_size,
+                                    n_chips, raw_ds, seed=rep)
         raw_rates.append(r)
+        raw_tel.append(t)
     jpeg_fed, jpeg_spread = _median_spread(jpeg_rates)
     raw_fed, raw_spread = _median_spread(raw_rates)
     host_jpeg = _host_only_rate(jpeg_ds(seed=99), 8, batch_size)
@@ -527,6 +581,13 @@ def _pipeline_benches(state, step, mesh, key, batch_size, n_chips) -> dict:
         "pipeline_fed_images_per_sec_per_chip": jpeg_fed,
         "pipeline_fed_spread_pct": jpeg_spread,
         "pipeline_fed_rates": [round(r, 1) for r in jpeg_rates],
+        # per-stage input-wait telemetry (median across reps): host_wait
+        # = producer blocked on tf.data, h2d_wait = consumer blocked on
+        # a ready device batch, step = consumer between-batch time; the
+        # frac says at a glance whether a fed-vs-synthetic gap is
+        # input-bound (link/host) or scheduling-bound
+        "pipeline_fed_input_wait": _tel_median(jpeg_tel),
+        "raw_record_fed_input_wait": _tel_median(raw_tel),
         "raw_record_fed_images_per_sec_per_chip": raw_fed,
         "raw_record_fed_spread_pct": raw_spread,
         "raw_record_fed_rates": [round(r, 1) for r in raw_rates],

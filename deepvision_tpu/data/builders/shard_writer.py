@@ -55,7 +55,18 @@ def write_sharded(
         for i in range(num_shards)
     ]
     if num_workers > 1:
-        with mp.Pool(num_workers) as pool:
+        # "spawn", not the platform-default fork: builders run inside
+        # processes that already initialized TensorFlow (and often the
+        # JAX client) — train.py data prep, bench.py, the test suite —
+        # and fork clones a multi-threaded runtime's held locks into
+        # the child. The observed failure is a silent pool deadlock:
+        # the tier-1 suite wedged at the first num_workers>1 builder
+        # test until the CI timeout killed it. Spawned workers start
+        # from a clean interpreter; the worker fn and items are
+        # picklable by construction (module-level fns / partials /
+        # _FeatureMaker instances).
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(num_workers) as pool:
             counts = pool.map(_write_one_shard, chunks)
     else:
         counts = [_write_one_shard(c) for c in chunks]

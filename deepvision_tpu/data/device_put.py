@@ -17,30 +17,17 @@ from __future__ import annotations
 
 from deepvision_tpu.core.mesh import shard_batch as shard_by_process
 
-__all__ = ["shard_by_process", "global_batch_size"]
+# Compat re-export: the synchronous in-loop generator this module used
+# to define became the threaded async feed in data/prefetch.py (same
+# contract — identical batches in identical order, ``depth`` transfers
+# in flight — but sharding runs on a producer thread so H2D overlaps
+# the step instead of serializing with it).
+from deepvision_tpu.data.prefetch import device_prefetch
+
+__all__ = ["shard_by_process", "global_batch_size", "device_prefetch"]
 
 
 def global_batch_size(mesh, per_device_batch: int) -> int:
     """per-device batch × all mesh data-axis devices (the reference's
     global-batch arithmetic, ref: YOLO/tensorflow/train.py:282)."""
     return per_device_batch * mesh.shape["data"]
-
-
-def device_prefetch(batches, mesh, *, depth: int = 2):
-    """Double-buffered host→device transfer: keep ``depth`` batches'
-    ``device_put`` dispatched ahead of the consumer so the wire transfer
-    overlaps the running step (jax transfers are async — the classic TPU
-    input double-buffering the reference's ``prefetch(1)`` does on the
-    host side only, ref: ResNet/tensorflow/train.py:195-204).
-    """
-    import collections
-
-    from deepvision_tpu.core.mesh import shard_batch
-
-    queue = collections.deque()
-    for batch in batches:
-        queue.append(shard_batch(mesh, batch))
-        if len(queue) > depth:
-            yield queue.popleft()
-    while queue:
-        yield queue.popleft()

@@ -438,6 +438,7 @@ def fit_gan(
     async_checkpoint: bool = False,
     preempt=None,
     watchdog=None,
+    prefetch_depth: int = 2,
 ):
     """Minimal GAN epoch loop: compiled step + loggers + TB + Orbax saves
     every ``save_every`` epochs keeping 3 (ref: DCGAN/tensorflow/main.py:39,
@@ -452,7 +453,11 @@ def fit_gan(
 
     ``watchdog``: optional Trainer.StallWatchdog — started here, beaten
     per step/drain, stopped on exit (same hang-detection contract as
-    Trainer.fit)."""
+    Trainer.fit).
+
+    ``prefetch_depth``: device batches kept in flight ahead of the step
+    by the async feed (data/prefetch.py); 1 = classic double
+    buffering."""
     from deepvision_tpu.core.step import (
         compile_checked_train_step,
         compile_train_step,
@@ -485,7 +490,7 @@ def fit_gan(
         state, loggers = _gan_epoch_loop(
             state, step, train_data, mesh, start_epoch, epochs,
             base_key, mgr, loggers, tb, save_every, log_every,
-            preempt, watchdog,
+            preempt, watchdog, prefetch_depth,
         )
     finally:
         # an exception mid-epoch must still stop the daemon watchdog
@@ -501,10 +506,10 @@ def fit_gan(
 
 def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
                     base_key, mgr, loggers, tb, save_every, log_every,
-                    preempt, watchdog):
-    from deepvision_tpu.data.device_put import device_prefetch
-
+                    preempt, watchdog, prefetch_depth=2):
     from deepvision_tpu.core.prng import KeySeq
+    from deepvision_tpu.data.prefetch import DevicePrefetcher, FeedTelemetry
+    from deepvision_tpu.train.loggers import input_wait_metrics
 
     for epoch in range(start_epoch, epochs):
         # epoch-derived noise stream (core.prng.KeySeq, the blessed
@@ -528,27 +533,38 @@ def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
                     watchdog.beat()
             pending.clear()
 
-        for i, device_batch in enumerate(
-            device_prefetch(train_data(epoch), mesh)
-        ):
-            state, metrics = step(state, device_batch, next(keys))
-            pending.append(metrics)
-            # beats land only in drain() (per COMPLETED step) — a
-            # dispatch-side beat would mask a wedged device until the
-            # dispatch queue itself blocked; cadence bounded at 32
-            # batches regardless of log_every (same fix as Trainer)
-            if watchdog is not None and i % min(32, log_every or 32) == 0:
-                drain()
-            if log_every and i % log_every == 0:
-                drain()  # syncs mostly-finished work; O(n) fetches total
-                print(f"[epoch {epoch} batch {i}] " + " ".join(
-                    f"{k}={v:.4f}" for k, v in sorted(fetched[-1].items())
-                ), flush=True)
+        # async H2D feed (data/prefetch.py, same as Trainer.train_epoch):
+        # producer-thread sharding keeps `prefetch_depth` transfers in
+        # flight; close() in the finally stops the thread on every exit
+        tel = FeedTelemetry()
+        feed = DevicePrefetcher(train_data(epoch), mesh,
+                                depth=prefetch_depth, telemetry=tel)
+        try:
+            for i, device_batch in enumerate(feed):
+                state, metrics = step(state, device_batch, next(keys))
+                pending.append(metrics)
+                # beats land only in drain() (per COMPLETED step) — a
+                # dispatch-side beat would mask a wedged device until the
+                # dispatch queue itself blocked; cadence bounded at 32
+                # batches regardless of log_every (same fix as Trainer)
+                if watchdog is not None \
+                        and i % min(32, log_every or 32) == 0:
+                    drain()
+                if log_every and i % log_every == 0:
+                    drain()  # syncs mostly-finished work; O(n) total
+                    print(f"[epoch {epoch} batch {i}] " + " ".join(
+                        f"{k}={v:.4f}"
+                        for k, v in sorted(fetched[-1].items())
+                    ), flush=True)
+        finally:
+            feed.close()
         drain()  # drains the dispatch queue — MUST precede the timing read
         epoch_metrics = {
             k: float(np.mean([m[k] for m in fetched]))
             for k in (fetched[0] if fetched else {})
         }
+        # per-stage feed telemetry, same metric names as the Trainer
+        epoch_metrics.update(input_wait_metrics(tel.summary()))
         loggers.log_metrics(epoch, epoch_metrics)
         for k, v in epoch_metrics.items():
             tb.scalar(k, v, epoch)

@@ -16,6 +16,28 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
+# telemetry summary keys (data/prefetch.FeedTelemetry.summary) promoted
+# to logged per-epoch metrics; host_wait is producer-side (upstream
+# iterator), shard is the producer's host-staging + device_put dispatch
+# (the wire-facing stage), h2d_wait is consumer-side (blocked on a
+# ready device batch), step is the consumer's between-batch time, and
+# the frac is wait/(wait+step) — >0.5 means the run is input-bound,
+# not chip-bound.
+_INPUT_WAIT_KEYS = ("host_wait_ms", "shard_ms", "h2d_wait_ms",
+                    "step_ms", "input_wait_frac")
+
+
+def input_wait_metrics(summary: dict, prefix: str = "input_") -> dict:
+    """Flatten a ``FeedTelemetry.summary()`` into loggable scalar
+    metrics (``input_host_wait_ms`` …) for ``Loggers``/TensorBoard —
+    the one place the per-stage feed telemetry gets its metric names,
+    shared by the Trainer epoch loop, the GAN loop, and ``bench.py``."""
+    return {
+        # "input_wait_frac" already carries the prefix in its name
+        (k if k.startswith(prefix) else prefix + k): float(summary[k])
+        for k in _INPUT_WAIT_KEYS if k in summary
+    }
+
 
 class Loggers:
     def __init__(self, metrics: list[str] | None = None):

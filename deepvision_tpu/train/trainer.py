@@ -32,9 +32,13 @@ import numpy as np
 from deepvision_tpu.core import shard_batch
 from deepvision_tpu.core.prng import KeySeq
 from deepvision_tpu.core.step import compile_eval_step, compile_train_step
-from deepvision_tpu.data.device_put import device_prefetch
+from deepvision_tpu.data.prefetch import DevicePrefetcher, FeedTelemetry
 from deepvision_tpu.train.checkpoint import CheckpointManager
-from deepvision_tpu.train.loggers import Loggers, TensorBoardWriter
+from deepvision_tpu.train.loggers import (
+    Loggers,
+    TensorBoardWriter,
+    input_wait_metrics,
+)
 from deepvision_tpu.train.optimizers import make_optimizer, set_lr_scale
 from deepvision_tpu.train.state import create_train_state
 from deepvision_tpu.train.steps import (
@@ -130,6 +134,7 @@ class Trainer:
         async_checkpoint: bool = False,
         keep_best: bool = False,
         data_echo: int = 1,
+        prefetch_depth: int = 2,
         stall_timeout: float | None = None,
         stall_abort: bool = False,
         rss_limit_gb: float | None = None,
@@ -146,6 +151,12 @@ class Trainer:
         # multiplying effective step throughput when the host pipeline or
         # H2D link — not the chip — is the bottleneck
         self.data_echo = max(1, int(data_echo))
+        # async feed (data/prefetch.py): device batches kept in flight
+        # ahead of the step; 1 = classic double buffering
+        if prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {prefetch_depth}")
+        self.prefetch_depth = int(prefetch_depth)
 
         # step-count schedules see OPTIMIZER steps: with echoing each
         # data epoch advances the counter data_echo * steps_per_epoch
@@ -490,56 +501,67 @@ class Trainer:
                 counts.append(len(batch["image"]))
                 yield batch
 
-        # double-buffered H2D: the next batch's transfer overlaps the
-        # running step (data/device_put.py)
-        for i, device_batch in enumerate(
-            device_prefetch(counted(), self.mesh)
-        ):
-            for _ in range(self.data_echo):  # device-side batch reuse
-                self.state, metrics = self._train_step(
-                    self.state, device_batch, next(keys)
-                )
-                pending.append(metrics)
-            # heartbeats land only in drain() (per COMPLETED step): a
-            # dispatch-side beat marks an ENQUEUED step, so a wedged
-            # device would keep "beating" until the dispatch queue
-            # blocked, stretching detection latency past the timeout.
-            # The watchdog forces its own drain cadence, bounded at 32
-            # batches regardless of log_every (log_every=500 would
-            # otherwise starve beats and false-trip healthy runs).
-            if self._watchdog and i % min(32, self.log_every or 32) == 0:
-                drain()
-            if (self.rss_limit_bytes
-                    and i % (self.log_every or 32) == 0):
-                rss = _process_rss()
-                if rss > self.rss_limit_bytes:
+        # async H2D feed (data/prefetch.py): a producer thread shards +
+        # device_puts `prefetch_depth` batches ahead so the wire
+        # transfer overlaps the running step; the telemetry splits the
+        # epoch wall time into host-wait / H2D-wait / step-compute.
+        # close() in the finally stops the producer thread on EVERY exit
+        # (preemption return, upstream exception), not just exhaustion.
+        tel = FeedTelemetry()
+        feed = DevicePrefetcher(counted(), self.mesh,
+                                depth=self.prefetch_depth, telemetry=tel)
+        try:
+            for i, device_batch in enumerate(feed):
+                for _ in range(self.data_echo):  # device-side batch reuse
+                    self.state, metrics = self._train_step(
+                        self.state, device_batch, next(keys)
+                    )
+                    pending.append(metrics)
+                # heartbeats land only in drain() (per COMPLETED step): a
+                # dispatch-side beat marks an ENQUEUED step, so a wedged
+                # device would keep "beating" until the dispatch queue
+                # blocked, stretching detection latency past the timeout.
+                # The watchdog forces its own drain cadence, bounded at 32
+                # batches regardless of log_every (log_every=500 would
+                # otherwise starve beats and false-trip healthy runs).
+                if self._watchdog \
+                        and i % min(32, self.log_every or 32) == 0:
+                    drain()
+                if (self.rss_limit_bytes
+                        and i % (self.log_every or 32) == 0):
+                    rss = _process_rss()
+                    if rss > self.rss_limit_bytes:
+                        print(
+                            f"[rss-limit] host RSS {rss/1e9:.2f}GB > "
+                            f"{self.rss_limit_bytes/1e9:.2f}GB — "
+                            "self-preempting (mid-epoch save; relaunch "
+                            "with --resume to continue in a fresh "
+                            "process)",
+                            flush=True,
+                        )
+                        self._rss_preempted = True
+                        self.request_preempt()
+                if self._preempt:
+                    # batch-granular: the resume point is a transferred-
+                    # batch index, so a preemption mid-echo-group replays
+                    # the group
+                    drain()  # park the dispatch queue before serializing
+                    self._save_preempt(epoch, start_step + i + 1)
+                    self.preempted = True
+                    return None
+                if self.log_every and i % self.log_every == 0:
+                    drain()  # syncs mostly-finished work; O(n) total
+                    # true running mean over EVERY batch so far, matching
+                    # the reference (ref: ResNet/pytorch/train.py:472-483)
+                    running = np.mean([m["loss"] for m in fetched])
                     print(
-                        f"[rss-limit] host RSS {rss/1e9:.2f}GB > "
-                        f"{self.rss_limit_bytes/1e9:.2f}GB — "
-                        "self-preempting (mid-epoch save; relaunch with "
-                        "--resume to continue in a fresh process)",
+                        f"[epoch {epoch} batch {i}] "
+                        f"loss={fetched[-1]['loss']:.4f} "
+                        f"running={running:.4f}",
                         flush=True,
                     )
-                    self._rss_preempted = True
-                    self.request_preempt()
-            if self._preempt:
-                # batch-granular: the resume point is a transferred-batch
-                # index, so a preemption mid-echo-group replays the group
-                drain()  # park the dispatch queue before serializing
-                self._save_preempt(epoch, start_step + i + 1)
-                self.preempted = True
-                return None
-            if self.log_every and i % self.log_every == 0:
-                drain()  # syncs mostly-finished work; O(n) fetches total
-                # true running mean over EVERY batch so far, matching the
-                # reference (ref: ResNet/pytorch/train.py:472-483)
-                running = np.mean([m["loss"] for m in fetched])
-                print(
-                    f"[epoch {epoch} batch {i}] "
-                    f"loss={fetched[-1]['loss']:.4f} "
-                    f"running={running:.4f}",
-                    flush=True,
-                )
+        finally:
+            feed.close()
         drain()  # drains the dispatch queue — MUST precede the timing read
         dt = time.perf_counter() - t0
         # throughput counts optimizer-processed samples; with echoing
@@ -557,6 +579,10 @@ class Trainer:
         }  # loss + whatever the step emits (top1/top5, YOLO loss parts…)
         if self.data_echo > 1:  # make echoed throughput attributable
             out["data_echo"] = float(self.data_echo)
+        # per-stage feed telemetry (input_host_wait_ms / input_h2d_wait_ms
+        # / input_step_ms / input_wait_frac): attributes a throughput gap
+        # to the host pipeline, the wire, or the step
+        out.update(input_wait_metrics(tel.summary()))
         out.update(
             examples_per_sec=n_images / dt,
             images_per_sec_per_chip=n_images / dt / n_chips,

@@ -186,6 +186,18 @@ _SLOW_TESTS = {
     # abstract-eval over all 24 registry entries (~2 min); `make lint`
     # runs the same gate directly via tools/jaxlint/evalcheck
     "test_evalcheck_full_registry",
+    # tier-1 budget fit (PR 3): the 870s 'not slow' budget on the 2-core
+    # box was being consumed by a handful of heavyweight tests (measured
+    # with --durations after fixing the shard_writer fork deadlock that
+    # previously wedged the suite at ~test 39 until the timeout). The
+    # f64 4x2-vs-8x1 full-step numeric pins (~190s each) and the
+    # longest preemption/convergence subprocess tests move to the slow
+    # tier; `make test` (full suite) still runs them.
+    "test_yolo_4x2_spatial_matches_8x1",
+    "test_hourglass_4x2_spatial_matches_8x1",
+    "test_sigterm_with_concurrent_resume_subprocess",
+    "test_echo_multiplies_steps_and_learns",
+    "test_inception_converter_main_logits_match",
 }
 # whole modules that spawn real subprocesses (jax.distributed workers)
 _SLOW_MODULES = {"test_distributed"}

@@ -467,6 +467,64 @@ def test_jx108_passes_constrained_layout_changes(tmp_path):
     assert codes(r) == []
 
 
+# ----------------------------------------------------------- JX109
+
+
+def test_jx109_flags_blocking_syncs_in_prefetch_loop(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        import jax
+        import numpy as np
+        from deepvision_tpu.data.prefetch import device_prefetch
+
+        def epoch(batches, mesh, step, state):
+            for i, db in enumerate(device_prefetch(batches, mesh)):
+                state, metrics = step(state, db)
+                loss = np.asarray(metrics["loss"])     # host sync
+                jax.block_until_ready(state.params)    # host sync
+                host = jax.device_get(metrics)         # host sync
+            return state
+        """)
+    assert codes(r) == ["JX109", "JX109", "JX109"]
+    assert "overlapping" in r.findings[0].message
+
+
+def test_jx109_tracks_name_bound_prefetcher_and_method_form(tmp_path):
+    # the repo idiom: prefetcher assigned to a name, then iterated;
+    # .block_until_ready() through a subscripted receiver still flags
+    r = lint(tmp_path, "lib/loop.py", """
+        from deepvision_tpu.data.prefetch import DevicePrefetcher
+
+        def epoch(batches, mesh, step, state):
+            feed = DevicePrefetcher(batches, mesh, depth=2)
+            for db in feed:
+                state, m = step(state, db)
+                m["loss"].block_until_ready()
+            return state
+        """)
+    assert codes(r) == ["JX109"]
+
+
+def test_jx109_passes_deferred_fetch_and_plain_loops(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        import numpy as np
+        from deepvision_tpu.data.prefetch import device_prefetch
+
+        def epoch(batches, mesh, step, state):
+            pending = []
+            for db in device_prefetch(batches, mesh):
+                state, m = step(state, db)
+                pending.append(m)        # defer: drain after the loop
+            fetched = [np.asarray(m["loss"]) for m in pending]
+            return state, fetched
+
+        def plain_host_loop(batches):
+            for b in batches:            # not a prefetched iterator
+                x = np.asarray(b)
+            return x
+        """)
+    assert codes(r) == []
+
+
 # ------------------------------------------- suppression + baseline
 
 

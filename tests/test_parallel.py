@@ -11,6 +11,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepvision_tpu.core.mesh import create_mesh
 from deepvision_tpu.parallel import halo_exchange, spatial_conv2d
+from deepvision_tpu.parallel.spatial import shard_map  # version-tolerant
 
 
 @pytest.fixture(scope="module")
@@ -29,7 +30,7 @@ def test_halo_exchange_rows(mesh42):
         .repeat(4, axis=0)  # batch divisible by the 4-way data axis
     )
 
-    out = jax.shard_map(
+    out = shard_map(
         lambda v: halo_exchange(v, 1, "model"),
         mesh=mesh42,
         in_specs=P("data", "model"),

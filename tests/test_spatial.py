@@ -19,6 +19,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepvision_tpu.core import create_mesh
 from deepvision_tpu.core.step import compiler_options
+
+# enable_x64 graduated from jax.experimental to the jax namespace across
+# the jaxlib builds this repo runs on; resolve the newest name first
+# (same env-skew class as the conftest XLA-flag probes)
+enable_x64 = getattr(jax, "enable_x64", None)
+if enable_x64 is None:  # pre-graduation jaxlib (e.g. 0.4.x)
+    from jax.experimental import enable_x64
 from deepvision_tpu.train.state import create_train_state
 from deepvision_tpu.train.steps import (
     classification_train_step,
@@ -186,7 +193,7 @@ def test_yolo_4x2_spatial_matches_8x1(rng):
     from deepvision_tpu.models import get_model
     from deepvision_tpu.train.steps import yolo_train_step
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         model = get_model("yolov3", num_classes=3, dtype=jnp.float64)
         images = rng.normal(size=(8, 64, 64, 3)).astype(np.float64)
         boxes = np.zeros((8, 4, 4), np.float64)
@@ -222,7 +229,7 @@ def test_hourglass_4x2_spatial_matches_8x1(rng):
     from deepvision_tpu.models.hourglass import StackedHourglass
     from deepvision_tpu.train.steps import pose_train_step
 
-    with jax.enable_x64(True):  # same rationale as the YOLO test
+    with enable_x64(True):  # same rationale as the YOLO test
         model = StackedHourglass(num_stacks=2, num_residual=1,
                                  num_heatmaps=3, features=32,
                                  dtype=jnp.float64)

@@ -20,6 +20,8 @@ Checker codes (tools/jaxlint/checkers.py):
     JX106  print() in traced code (use jax.debug.print)
     JX107  jnp/jax.numpy in a host data pipeline (data/ must stay on host)
     JX108  reshape/transpose in parallel/ without a sharding constraint
+    JX109  blocking host sync (np.asarray/.block_until_ready()/
+           jax.device_get) inside a loop consuming a prefetched iterator
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

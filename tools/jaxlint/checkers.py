@@ -20,6 +20,7 @@ from tools.jaxlint.core import (
     FunctionNode,
     ModuleContext,
     array_names_in,
+    assign_target_names,
     call_name,
     dotted_name,
     last_attr,
@@ -692,4 +693,88 @@ class ConstraintChecker(Checker):
                 for sub in ast.walk(node):
                     if sub is call:
                         return True
+        return False
+
+
+@register_checker
+class PrefetchLoopSyncChecker(Checker):
+    """Blocking host syncs inside a loop consuming a prefetched iterator
+    (``device_prefetch``/``DevicePrefetcher`` — data/prefetch.py): every
+    ``np.asarray``/``block_until_ready``/``jax.device_get`` in the body
+    parks the host until the device drains, so the producer thread's
+    queued H2D transfers stop overlapping anything and the async feed
+    degrades back to the synchronous pipeline it replaced. Fetch metrics
+    after the loop, or batch them through the pending/drain pattern
+    (train/trainer.py)."""
+
+    code = "JX109"
+    name = "sync-in-prefetch-loop"
+    description = ("blocking host sync (np.asarray / .block_until_ready "
+                   "/ jax.device_get) inside a loop consuming a "
+                   "prefetched iterator")
+
+    # host-blocking calls that serialize the feed when they appear in
+    # the hot loop; float()/`.item()` on metrics is JX101's territory
+    # (traced code) — here the loop is host code, and the listed calls
+    # block unconditionally rather than per-element
+    _BLOCKING_ATTRS = {"block_until_ready", "device_get"}
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        prefetch = set(mod.cfg.prefetch_funcs)
+        # names bound to a prefetch-factory result (`feed =
+        # DevicePrefetcher(...)` then `for b in feed:` — the repo idiom);
+        # module-coarse name tracking is plenty for a linter
+        names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            value = getattr(node, "value", None)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and isinstance(value, ast.Call) \
+                    and last_attr(call_name(value)) in prefetch:
+                names.update(assign_target_names(node))
+        flagged: set[int] = set()  # nested prefetch loops: report once
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            if not self._is_prefetch_iter(node.iter, prefetch, names):
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Call) \
+                            or id(sub) in flagged:
+                        continue
+                    name = call_name(sub)
+                    # method form reaches receivers call_name can't
+                    # resolve (x["loss"].block_until_ready())
+                    method = (sub.func.attr
+                              if isinstance(sub.func, ast.Attribute)
+                              else None)
+                    blocking = (
+                        name in _NP_MATERIALIZERS
+                        or last_attr(name) in self._BLOCKING_ATTRS
+                        or method in self._BLOCKING_ATTRS
+                    )
+                    if blocking:
+                        flagged.add(id(sub))
+                        label = name or f".{method}()"
+                        yield mod.finding(
+                            sub, self.code,
+                            f"'{label}' blocks the host inside a "
+                            "prefetched-input loop: the async feed's "
+                            "queued H2D transfers stop overlapping the "
+                            "step while the host waits; fetch after the "
+                            "loop (or batch via the pending/drain "
+                            "pattern, train/trainer.py)")
+
+    @staticmethod
+    def _is_prefetch_iter(expr: ast.AST, prefetch: set[str],
+                          names: set[str]) -> bool:
+        """True when the loop's iterable is (or wraps, e.g. via
+        ``enumerate``/``zip``) a prefetch-factory call or a name bound
+        to one."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) \
+                    and last_attr(call_name(node)) in prefetch:
+                return True
+            if isinstance(node, ast.Name) and node.id in names:
+                return True
         return False

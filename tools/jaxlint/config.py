@@ -236,6 +236,12 @@ class LintConfig:
     constraint_funcs: list[str] = field(default_factory=lambda: [
         "with_sharding_constraint", "guard_thin_h",
     ])
+    # Iterator factories whose consuming loops are overlapped-H2D hot
+    # loops (JX109): a blocking host sync inside one stalls the async
+    # feed — the queued transfers drain while the host waits.
+    prefetch_funcs: list[str] = field(default_factory=lambda: [
+        "device_prefetch", "DevicePrefetcher", "prefetch_to_device",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -254,7 +260,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "traced_dirs", "data_dirs", "parallel_dirs",
         "traced_name_patterns", "jit_wrappers", "static_return_calls",
         "key_fresheners", "key_name_patterns", "constraint_funcs",
-        "disable",
+        "prefetch_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

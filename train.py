@@ -107,6 +107,12 @@ def parse_args():
                         "echoing, arXiv:1907.05550) — multiplies step "
                         "throughput when the input pipeline or H2D "
                         "link, not the chip, is the bottleneck")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="device batches the async feed keeps in flight "
+                        "ahead of the step (data/prefetch.py); 1 = "
+                        "classic double buffering, larger values ride "
+                        "out host-pipeline jitter at the cost of one "
+                        "staged batch of host+HBM memory each")
     return p.parse_args()
 
 
@@ -159,6 +165,9 @@ def main():
     if args.stall_timeout < 0:
         raise SystemExit(
             f"--stall-timeout must be >= 0, got {args.stall_timeout}")
+    if args.prefetch_depth < 1:
+        raise SystemExit(
+            f"--prefetch-depth must be >= 1, got {args.prefetch_depth}")
     if cfg["dataset"].startswith("gan"):
         run_gan(args, cfg, dtype)
         return
@@ -350,6 +359,7 @@ def main():
         shard_weight_update=args.shard_weight_update,
         async_checkpoint=args.async_checkpoint,
         keep_best=args.keep_best, data_echo=args.data_echo,
+        prefetch_depth=args.prefetch_depth,
         stall_timeout=args.stall_timeout or None,
         stall_abort=args.stall_abort,
         rss_limit_gb=args.rss_limit_gb or None, **step_fns,
@@ -522,6 +532,7 @@ def run_gan(args, cfg, dtype):
         async_checkpoint=args.async_checkpoint,
         preempt=preempted,
         watchdog=watchdog,
+        prefetch_depth=args.prefetch_depth,
     )
     if preempted():
         raise SystemExit(143)

@@ -18,9 +18,14 @@ Mechanics (SURVEY §5.8's DCN mapping):
   ``jax.devices()`` then spans every chip of every host and the regular
   ``create_mesh`` lays the global (data, model) mesh over ICI + DCN.
 - each process feeds only its own file shard
-  (``make_dataset(num_process=, process_index=)``) and
-  ``core.shard_batch`` assembles per-process local arrays into global
-  jax.Arrays (``jax.make_array_from_process_local_data``).
+  (``make_dataset(num_process=, process_index=)``), pushed through its
+  own async device-feed thread (``data/prefetch.py`` — per-process
+  prefetch + overlapped H2D), and ``core.shard_batch`` assembles
+  per-process local arrays into global jax.Arrays
+  (``jax.make_array_from_process_local_data``). Multi-host runs default
+  to ``--prefetch-depth 3`` (one extra in-flight batch) because the
+  global-array assembly adds per-batch latency jitter a deeper queue
+  absorbs; pass the flag explicitly to override.
 - everything else — step functions, checkpointing (Orbax is
   multi-process-aware), metrics — is identical to single-host train.py,
   which this script delegates to after initialization.
@@ -63,6 +68,14 @@ def main():
         f"{jax.local_device_count()} local / "
         f"{jax.device_count()} global devices"
     )
+
+    if jax.process_count() > 1 and not any(
+            a == "--prefetch-depth" or a.startswith("--prefetch-depth=")
+            for a in train_argv):
+        # deeper default on real multi-host runs: the per-batch
+        # make_array_from_process_local_data assembly adds latency
+        # jitter that a 2-deep queue lets through to the step
+        train_argv += ["--prefetch-depth", "3"]
 
     sys.argv = [sys.argv[0], *train_argv]
     import train
