@@ -50,10 +50,25 @@ lint:
 	$(PY) -m tools.jaxlint deepvision_tpu/
 	$(PY) -m tools.jaxlint.evalcheck
 
-# the default CI path: hazard lint + whole-zoo shape gate + full suite
-# (the suite's own full-registry evalcheck test is deselected — `lint`
-# above just ran the identical ~2-min gate via the CLI)
-check: lint
+# serving smoke: boot the stdin-JSONL server on lenet5 (compiles its
+# bucket executables at startup), push 3 requests through the engine,
+# assert 3 results come back — the `make check` serving gate
+serve-smoke:
+	$(PY) -c "import json, numpy as np; \
+	    [print(json.dumps({'id': i, 'model': 'lenet5', \
+	     'input': np.zeros((32, 32, 1)).tolist()})) for i in range(3)]" \
+	| $(PY) serve.py -m lenet5 --buckets 1,4 \
+	| $(PY) -c "import sys, json; \
+	    rows = [json.loads(l) for l in sys.stdin if l.strip()]; \
+	    ok = [r for r in rows if 'result' in r]; \
+	    assert len(ok) == 3, rows; \
+	    print('serve-smoke OK (3/3 responses)')"
+
+# the default CI path: hazard lint + serving smoke + whole-zoo shape
+# gate + full suite (the suite's own full-registry evalcheck test is
+# deselected — `lint` above just ran the identical ~2-min gate via the
+# CLI)
+check: lint serve-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -177,4 +192,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint check bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint check serve-smoke bench dryrun tensorboard find-python list-models rehearsal

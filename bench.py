@@ -598,5 +598,88 @@ def _pipeline_benches(state, step, mesh, key, batch_size, n_chips) -> dict:
     }
 
 
+# ---- serving bench (`python bench.py serve`) ----------------------------
+# Offered load vs achieved throughput + tail latency for the batched
+# inference engine (deepvision_tpu/serve/), against the sequential
+# batch-1 closed loop that predict.py-style calls amount to. Kept on
+# lenet5 so the whole thing (4 bucket compiles + 2 measured phases)
+# stays seconds-cheap even on a CPU-only container.
+SERVE_REQUESTS = 512
+SERVE_SEQ_CALLS = 64
+
+
+def serve_bench(n_requests: int = SERVE_REQUESTS) -> dict:
+    import contextlib
+    import sys
+
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.serve import InferenceEngine
+    from deepvision_tpu.serve.models import load_served
+
+    rng = np.random.default_rng(0)
+    # restore chatter to stderr: stdout is the one-JSON-line contract
+    with contextlib.redirect_stdout(sys.stderr):
+        served = load_served("lenet5", None, num_classes=10)
+    engine = InferenceEngine(
+        [served], mesh=create_mesh(1, 1), buckets=(1, 4, 16, 64),
+        max_queue=max(1024, 2 * n_requests),
+    )
+    xs = rng.normal(size=(n_requests, 32, 32, 1)).astype(np.float32)
+    try:
+        # pace both paths past first-dispatch jitter (all executables
+        # are already compiled — warmup ran in the constructor)
+        for i in range(8):
+            engine.submit(xs[i]).result(timeout=60)
+        misses_warm = engine.stats()["cache"]["misses"]
+
+        # 1) sequential closed loop: submit → wait, one at a time — the
+        # predict.py batch-1 pattern every request pays without batching
+        t0 = time.perf_counter()
+        for i in range(SERVE_SEQ_CALLS):
+            engine.submit(xs[i % n_requests]).result(timeout=60)
+        seq_rate = SERVE_SEQ_CALLS / (time.perf_counter() - t0)
+
+        # 2) saturation burst: offer everything at once; the dispatcher
+        # drains the backlog through the biggest buckets
+        t0 = time.perf_counter()
+        futures = [engine.submit(x) for x in xs]
+        t_offered = time.perf_counter() - t0
+        for f in futures:
+            f.result(timeout=120)
+        dt = time.perf_counter() - t0
+        sat_rate = n_requests / dt
+
+        stats = engine.stats()
+        tel = stats["telemetry"]
+        return {
+            "metric": "serve_lenet5_requests_per_sec",
+            "value": round(sat_rate, 1),
+            "unit": "requests/sec",
+            "sequential_batch1_per_sec": round(seq_rate, 1),
+            "speedup_vs_sequential": round(sat_rate / seq_rate, 2),
+            "offered_load_per_sec": round(n_requests / t_offered, 1),
+            "achieved_frac_of_offered": round(
+                sat_rate * t_offered / n_requests, 4),
+            "e2e_latency": tel["e2e_latency"],
+            "queue_wait": tel["queue_wait"],
+            "device_time": tel["device_time"],
+            "pad_overhead_frac": tel["pad_overhead_frac"],
+            "mean_batch_rows": tel["mean_batch_rows"],
+            "warmup_s": stats["warmup_s"],
+            "cache": stats["cache"],
+            # acceptance tripwire: no request after warmup may compile
+            "no_retrace_after_warmup": (
+                stats["cache"]["misses"] == misses_warm),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+    finally:
+        engine.close()
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "serve" in sys.argv[1:]:
+        print(json.dumps(serve_bench()))
+    else:
+        main()

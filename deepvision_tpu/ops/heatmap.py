@@ -61,3 +61,23 @@ def gaussian_heatmaps(
     )
     vis = (jnp.asarray(visible) > 0)[..., None, None, :]
     return jnp.where(inside & vis, g, 0.0).astype(jnp.float32)
+
+
+def decode_heatmaps(heatmaps: jnp.ndarray):
+    """(..., H, W, K) heatmaps -> per-joint argmax as ``(kx, ky, conf)``,
+    each (..., K); kx/ky are normalized cell-center fractions of
+    width/height (the inverse of :func:`gaussian_heatmaps`' encoding).
+
+    The inference counterpart of the encoder above: a fixed-shape pure
+    jnp reduction, so pose decoding runs INSIDE the compiled serving
+    forward (serve/models.py) instead of as a host-side numpy
+    ``unravel_index`` loop per image.
+    """
+    heatmaps = jnp.asarray(heatmaps, jnp.float32)
+    h, w, k = heatmaps.shape[-3:]
+    flat = heatmaps.reshape(*heatmaps.shape[:-3], h * w, k)
+    idx = jnp.argmax(flat, axis=-2)
+    conf = jnp.max(flat, axis=-2)
+    ky = (idx // w).astype(jnp.float32) / h
+    kx = (idx % w).astype(jnp.float32) / w
+    return kx, ky, conf

@@ -1,0 +1,47 @@
+"""deepvision_tpu.serve — batched inference engine for the model zoo.
+
+The serving runtime layer (ROADMAP north star: "serves heavy traffic"):
+
+- ``engine``        : background dispatcher draining a bounded request
+                      queue into padded, bucket-laddered micro-batches
+                      over pre-compiled mesh-sharded executables, with
+                      per-request futures + deadline support.
+- ``compile_cache`` : LRU of AOT-compiled executables keyed by
+                      (model, bucket, dtype), eagerly warmed so no
+                      request pays a trace.
+- ``models``        : ServedModel — one restore + per-task postprocess
+                      path (classify/detect/pose/gan) shared by
+                      ``predict.py`` and the server; also wraps
+                      StableHLO artifacts from ``export.py``.
+- ``admission``     : queue-depth backpressure, per-model limits, and
+                      reject-with-retry-after shedding.
+- ``telemetry``     : queue-wait / pad-overhead / device-time / e2e
+                      histograms with p50/p95/p99 snapshots.
+
+The CLI lives at the repo root (``serve.py``: stdin-JSONL and HTTP);
+``bench.py serve`` measures offered load vs achieved throughput.
+"""
+
+from deepvision_tpu.serve.admission import AdmissionController, ShedError
+from deepvision_tpu.serve.compile_cache import CompileCache
+from deepvision_tpu.serve.engine import InferenceEngine
+from deepvision_tpu.serve.models import (
+    ServedModel,
+    from_stablehlo,
+    load_served,
+    restore_state,
+)
+from deepvision_tpu.serve.telemetry import LatencyStats, ServeTelemetry
+
+__all__ = [
+    "AdmissionController",
+    "ShedError",
+    "CompileCache",
+    "InferenceEngine",
+    "ServedModel",
+    "from_stablehlo",
+    "load_served",
+    "restore_state",
+    "LatencyStats",
+    "ServeTelemetry",
+]

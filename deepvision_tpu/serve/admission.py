@@ -1,0 +1,109 @@
+"""Admission control: bounded queues, per-model limits, graceful shed.
+
+The one decision this module encodes: when the engine cannot keep up,
+reject new work IMMEDIATELY with a retry hint instead of queueing it
+into unbounded latency. An admitted request has a bounded worst-case
+wait (queue depth × observed per-row service time); an unbounded queue
+turns overload into timeouts for *every* request instead of sheds for
+the marginal ones — the classic load-shedding argument, and the serving
+analog of the feed pipeline's bounded-queue backpressure
+(``data/prefetch.py``).
+
+:class:`ShedError` carries ``retry_after_s`` (estimated time for the
+backlog to drain), which the HTTP surface maps to ``429 Retry-After``
+and the JSONL surface to a ``retry_after`` field.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AdmissionController", "ShedError"]
+
+
+class ShedError(RuntimeError):
+    """Request rejected at admission (queue saturated). ``retry_after_s``
+    estimates when capacity frees up."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    """Queue-depth backpressure + per-model concurrency limits.
+
+    - ``max_queue``: total requests admitted-but-unresolved across all
+      models; the engine's worst-case memory and latency bound.
+    - ``per_model_limit``: optional cap per model, so one hot model
+      cannot starve the rest of the host's queue budget.
+
+    ``observe_batch`` maintains an EWMA of per-row service time; the
+    shed hint is ``depth × row_s`` — how long the current backlog needs
+    to drain at the observed rate.
+    """
+
+    def __init__(self, max_queue: int = 256,
+                 per_model_limit: int | None = None,
+                 ewma_alpha: float = 0.2):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.per_model_limit = per_model_limit
+        self._alpha = ewma_alpha
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._total = 0
+        self._row_s = 0.005  # EWMA per-row service time (seed guess)
+
+    # -- admission -------------------------------------------------------
+    def admit(self, model: str) -> None:
+        """Reserve a queue slot for one request, or raise ShedError."""
+        with self._lock:
+            if self._total >= self.max_queue:
+                raise ShedError(
+                    f"queue full ({self._total}/{self.max_queue} pending)",
+                    self._retry_after_locked())
+            if self.per_model_limit is not None \
+                    and self._counts.get(model, 0) >= self.per_model_limit:
+                raise ShedError(
+                    f"model {model!r} at its concurrency limit "
+                    f"({self.per_model_limit})",
+                    self._retry_after_locked())
+            self._counts[model] = self._counts.get(model, 0) + 1
+            self._total += 1
+
+    def release(self, model: str) -> None:
+        """Free one slot (request resolved: completed / timed out /
+        failed / dropped at close)."""
+        with self._lock:
+            self._counts[model] = max(0, self._counts.get(model, 0) - 1)
+            self._total = max(0, self._total - 1)
+
+    # -- service-rate observation ---------------------------------------
+    def observe_batch(self, device_s: float, rows: int) -> None:
+        if rows <= 0:
+            return
+        with self._lock:
+            per_row = device_s / rows
+            self._row_s += self._alpha * (per_row - self._row_s)
+
+    def _retry_after_locked(self) -> float:
+        return round(max(0.01, self._total * self._row_s), 3)
+
+    # -- introspection ---------------------------------------------------
+    def depth(self, model: str | None = None) -> int:
+        with self._lock:
+            if model is not None:
+                return self._counts.get(model, 0)
+            return self._total
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "depth": self._total,
+                "max_queue": self.max_queue,
+                "per_model_limit": self.per_model_limit,
+                "per_model_depth": dict(self._counts),
+                "ewma_row_ms": round(self._row_s * 1e3, 3),
+            }

@@ -1,0 +1,78 @@
+"""Shape-bucketed executable cache: compile once, serve forever.
+
+Serving traffic arrives at arbitrary batch sizes, but XLA executables
+are shape-specialized — a naive per-request ``jit`` retraces on every
+new batch size and the chip spends its time in the compiler instead of
+the MXU (jaxlint JX110 flags exactly that pattern). The engine instead
+pads every micro-batch up to a fixed bucket ladder and runs a
+pre-compiled executable per ``(model, bucket, dtype)`` key, all of them
+compiled eagerly at startup (:meth:`CompileCache.warmup` via
+``engine.InferenceEngine``) so no request ever pays a trace.
+
+The cache is an LRU so a long-lived multi-model host with a rotating
+model set stays bounded; with the default ladder (4 buckets × a few
+models) nothing ever evicts. Hit/miss/eviction counters feed the
+telemetry ``/stats`` snapshot — after warmup, ``misses`` must stay
+frozen (the acceptance tripwire for "no request triggers a compile").
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Hashable
+
+__all__ = ["CompileCache"]
+
+
+class CompileCache:
+    """LRU of compiled executables keyed by ``(model, bucket, dtype)``.
+
+    ``build`` callables passed to :meth:`get_or_build` return the ready
+    runner (typically an AOT ``jit(...).lower(...).compile()`` wrapper);
+    the cache never inspects them. Builds run under the lock — the
+    builders are only ever invoked from the engine's warmup and its
+    single dispatcher thread, and serializing them is the point (two
+    concurrent compiles of the same key would both pay the trace).
+    """
+
+    def __init__(self, max_entries: int = 64):
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.max_entries = max_entries
+        self._entries: OrderedDict[Hashable, Callable] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_build(self, key: Hashable, build: Callable[[], Callable]):
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                self._entries.move_to_end(key)
+                return self._entries[key]
+            self.misses += 1
+            runner = build()
+            self._entries[key] = runner
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return runner
+
+    def contains(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

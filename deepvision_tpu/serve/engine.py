@@ -1,0 +1,401 @@
+"""Batched inference engine: queue → micro-batch → bucket → executable.
+
+The runtime counterpart of the compile-once / shape-stable discipline
+the training side already enforces (jaxlint JX105/JX110): a background
+dispatcher thread drains a bounded request queue into per-model
+micro-batches, pads each batch with zero rows up to a fixed bucket
+ladder (default 1/4/16/64), and runs a pre-compiled, input-donated,
+mesh-sharded forward per ``(model, bucket, dtype)`` from the
+:class:`~deepvision_tpu.serve.compile_cache.CompileCache` — eagerly
+warmed at startup so no request ever pays a trace. This is the MLPerf
+serving recipe (PAPERS.md "Scale MLPerf-0.6 models on Google TPU-v3
+Pods"): sustained accelerator utilization comes from keeping a fixed
+set of hot executables fed with full batches.
+
+Guarantees (mirroring ``data/prefetch.DevicePrefetcher``'s contract
+style):
+
+- **pad isolation** — padded rows are zero inputs whose outputs are
+  sliced away before postprocess; they can never leak into a result
+  (per-example forwards: eval-mode BN uses running stats, so rows are
+  independent).
+- **bounded latency or shed** — admission control
+  (``admission.AdmissionController``) rejects work with a retry-after
+  hint once the queue saturates, instead of queueing into unbounded
+  latency.
+- **deadline honesty** — a request whose deadline passes while queued
+  resolves with ``TimeoutError``, never a late (or wrong) answer.
+- **clean shutdown** — ``close()`` stops and joins the dispatcher and
+  fails any still-pending futures; no threads or orphaned requests
+  leak.
+
+Every request resolves a ``concurrent.futures.Future``; telemetry
+(``telemetry.ServeTelemetry``) attributes each request's wall time to
+queue-wait / device-time / e2e and tracks the pad overhead per batch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Iterable
+
+import numpy as np
+
+from deepvision_tpu.serve.admission import AdmissionController, ShedError
+from deepvision_tpu.serve.compile_cache import CompileCache
+from deepvision_tpu.serve.models import ServedModel
+from deepvision_tpu.serve.telemetry import ServeTelemetry
+
+__all__ = ["InferenceEngine", "ShedError"]
+
+_WAKE = object()  # queue sentinel: wake the dispatcher without a request
+
+
+class _Request:
+    __slots__ = ("model", "x", "future", "t_submit", "deadline")
+
+    def __init__(self, model: str, x, deadline: float | None):
+        self.model = model
+        self.x = x
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline
+
+
+class InferenceEngine:
+    """Multi-model batched inference over one device mesh.
+
+    ``models``: ServedModel instances (or a name->model dict). The
+    bucket ladder applies to every model that doesn't carry its own
+    (StableHLO artifacts are pinned to their exported batch). Every
+    bucket must be divisible by the mesh's data-axis size — the batch
+    dim is sharded over it.
+
+    ``batch_window_s``: after the first request of a batch arrives, how
+    long the dispatcher waits for the bucket to fill before running a
+    partial (padded) batch. 0 trades padding for latency; saturation
+    traffic fills buckets regardless via the backlog.
+    """
+
+    def __init__(
+        self,
+        models: Iterable[ServedModel] | dict[str, ServedModel],
+        *,
+        mesh=None,
+        buckets: tuple[int, ...] = (1, 4, 16, 64),
+        max_queue: int = 256,
+        per_model_limit: int | None = None,
+        batch_window_s: float = 0.0,
+        warmup: bool = True,
+        cache_entries: int = 64,
+        telemetry: ServeTelemetry | None = None,
+    ):
+        if isinstance(models, dict):
+            self._models = dict(models)
+        else:
+            self._models = {m.name: m for m in models}
+        if not self._models:
+            raise ValueError("engine needs at least one ServedModel")
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"bucket ladder must be sorted unique, "
+                             f"got {buckets}")
+        if mesh is None:
+            from deepvision_tpu.core.mesh import create_mesh
+
+            mesh = create_mesh(1, 1)  # single-device default: serving a
+            # host; pass an explicit mesh to shard batches over chips
+        self._mesh = mesh
+        self.buckets = tuple(buckets)
+        self._check_ladders()
+        self.telemetry = telemetry if telemetry is not None \
+            else ServeTelemetry()
+        self._cache = CompileCache(max_entries=cache_entries)
+        self._admission = AdmissionController(
+            max_queue=max_queue, per_model_limit=per_model_limit)
+        self._window = batch_window_s
+        self._poll_s = 0.05
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self.warmup_s = 0.0
+        self._replicate_variables()
+        if warmup:
+            self.warm()
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    # -- setup -----------------------------------------------------------
+    def _check_ladders(self) -> None:
+        n_data = self._mesh.shape.get("data", 1)
+        for m in self._models.values():
+            for b in self.ladder(m):
+                if b % n_data:
+                    raise ValueError(
+                        f"bucket {b} for model {m.name!r} is not "
+                        f"divisible by the mesh data axis ({n_data}); "
+                        "batches are sharded over it")
+
+    def _replicate_variables(self) -> None:
+        """Place every model's variables replicated on the mesh once, so
+        per-batch calls never re-place (or worse, re-transfer) params."""
+        import jax
+
+        from deepvision_tpu.core.mesh import replicated_sharding
+
+        sharding = replicated_sharding(self._mesh)
+        for m in self._models.values():
+            if m.variables is not None:
+                m.variables = jax.device_put(m.variables, sharding)
+
+    def ladder(self, model: ServedModel) -> tuple[int, ...]:
+        return model.buckets if model.buckets else self.buckets
+
+    def warm(self) -> None:
+        """Eagerly compile every (model, bucket) executable so no
+        request ever pays a trace; time recorded in ``warmup_s``."""
+        t0 = time.perf_counter()
+        for m in self._models.values():
+            for bucket in self.ladder(m):
+                self._cache.get_or_build(
+                    (m.name, bucket, m.dtype_str),
+                    lambda m=m, bucket=bucket: m.compile_for(
+                        bucket, self._mesh),
+                )
+        self.warmup_s = round(time.perf_counter() - t0, 3)
+
+    # -- client surface --------------------------------------------------
+    def submit(self, x, model: str | None = None, *,
+               timeout_s: float | None = None) -> Future:
+        """Enqueue one example (no batch dim) for ``model``; returns a
+        Future resolving to the task's result dict. Raises
+        :class:`ShedError` immediately when admission rejects, and
+        ``ValueError`` on shape/model mismatch (fail fast, not in the
+        dispatcher)."""
+        if model is None:
+            if len(self._models) != 1:
+                raise ValueError(
+                    f"engine hosts {sorted(self._models)}; pass model=")
+            (model,) = self._models  # the single-model host default
+        served = self._models.get(model)
+        if served is None:
+            raise ValueError(f"unknown model {model!r}; serving "
+                             f"{sorted(self._models)}")
+        if self._stop.is_set():
+            raise RuntimeError("engine is closed")
+        x = np.asarray(x, dtype=served.input_dtype)
+        if x.shape != served.input_shape:
+            raise ValueError(
+                f"{model!r} expects input shape {served.input_shape}, "
+                f"got {x.shape}")
+        try:
+            self._admission.admit(model)
+        except ShedError:
+            self.telemetry.record_shed()
+            raise
+        self.telemetry.record_submit()
+        req = _Request(
+            model, x,
+            deadline=(time.perf_counter() + timeout_s
+                      if timeout_s is not None else None))
+        self._q.put(req)
+        if self._stop.is_set():
+            # raced close(): the dispatcher's exit drain may already
+            # have passed — make sure this future resolves either way.
+            # Releaser = whoever resolves the future, exactly once
+            # (same rule as _resolve_dropped), so the slot is never
+            # double-released when both sides race.
+            try:
+                req.future.set_exception(RuntimeError("engine closed"))
+            except InvalidStateError:
+                pass  # dispatcher's drain resolved (and released)
+            else:
+                self._admission.release(model)
+        return req.future
+
+    def stats(self) -> dict:
+        """JSON-able state for ``/stats`` and the bench report."""
+        return {
+            "models": sorted(self._models),
+            "buckets": list(self.buckets),
+            "warmup_s": self.warmup_s,
+            "queue": self._admission.stats(),
+            "cache": self._cache.stats(),
+            "telemetry": self.telemetry.snapshot(),
+        }
+
+    # pause/resume: used by drains and tests that need deterministic
+    # queue buildup (backpressure, deadline expiry) without sleeping on
+    # a compile race
+    def pause(self) -> None:
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+        self._q.put(_WAKE)
+
+    # -- dispatcher ------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        pending: dict[str, list[_Request]] = {
+            name: [] for name in self._models}
+        rr = list(self._models)  # round-robin cursor over models
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                time.sleep(0.002)
+                continue
+            self._drain_inbound(
+                pending, block=not any(pending.values()))
+            if self._stop.is_set() or self._paused.is_set():
+                continue
+            name = self._next_model(pending, rr)
+            if name is None:
+                continue
+            served = self._models[name]
+            ladder_max = max(self.ladder(served))
+            self._fill_window(pending, name, ladder_max)
+            reqs = pending[name][:ladder_max]
+            del pending[name][:ladder_max]
+            live = self._expire(reqs)
+            if live:
+                self._run_batch(served, live)
+        # drain: fail anything still queued/pending so no caller blocks
+        # forever on a future the dispatcher will never resolve
+        self._drain_inbound(pending, block=False)
+        for reqs in pending.values():
+            for r in reqs:
+                self._resolve_dropped(r)
+
+    def _drain_inbound(self, pending, block: bool) -> None:
+        try:
+            item = (self._q.get(timeout=self._poll_s) if block
+                    else self._q.get_nowait())
+        except queue.Empty:
+            return
+        while True:
+            if item is not _WAKE:
+                pending[item.model].append(item)
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    @staticmethod
+    def _next_model(pending, rr: list[str]) -> str | None:
+        for _ in range(len(rr)):
+            name = rr.pop(0)
+            rr.append(name)
+            if pending[name]:
+                return name
+        return None
+
+    def _fill_window(self, pending, name: str, ladder_max: int) -> None:
+        """Give the queue up to ``batch_window_s`` (from the oldest
+        pending request) to fill the largest bucket before running a
+        padded partial batch."""
+        if self._window <= 0:
+            return
+        until = pending[name][0].t_submit + self._window
+        while len(pending[name]) < ladder_max \
+                and not self._stop.is_set():
+            remaining = until - time.perf_counter()
+            if remaining <= 0:
+                return
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                return
+            if item is not _WAKE:
+                pending[item.model].append(item)
+
+    def _expire(self, reqs: list[_Request]) -> list[_Request]:
+        now = time.perf_counter()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                r.future.set_exception(TimeoutError(
+                    f"deadline expired after "
+                    f"{now - r.t_submit:.3f}s in queue"))
+                self.telemetry.record_timeout()
+                self._admission.release(r.model)
+            else:
+                live.append(r)
+        return live
+
+    def _bucket_for(self, served: ServedModel, n: int) -> int:
+        for b in self.ladder(served):
+            if b >= n:
+                return b
+        return max(self.ladder(served))
+
+    def _run_batch(self, served: ServedModel, reqs: list[_Request]) -> None:
+        import jax
+
+        from deepvision_tpu.core.mesh import data_sharding
+
+        t_dispatch = time.perf_counter()
+        n = len(reqs)
+        bucket = self._bucket_for(served, n)
+        x = np.zeros((bucket, *served.input_shape), served.input_dtype)
+        for i, r in enumerate(reqs):
+            x[i] = r.x
+        try:
+            runner = self._cache.get_or_build(
+                (served.name, bucket, served.dtype_str),
+                lambda: served.compile_for(bucket, self._mesh),
+            )
+            xd = jax.device_put(x, data_sharding(self._mesh, x.ndim))
+            t0 = time.perf_counter()
+            host = jax.device_get(runner(xd))
+            t_dev = time.perf_counter() - t0
+        except Exception as e:  # device/compile failure: fail the batch
+            for r in reqs:
+                r.future.set_exception(e)
+                self.telemetry.record_failure()
+                self._admission.release(r.model)
+            return
+        self.telemetry.record_batch(bucket=bucket, rows=n, device_s=t_dev)
+        self._admission.observe_batch(t_dev, n)
+        now = time.perf_counter()
+        for i, r in enumerate(reqs):
+            try:
+                result = served.postprocess(host, i)
+            except Exception as e:
+                r.future.set_exception(e)
+                self.telemetry.record_failure()
+            else:
+                r.future.set_result(result)
+                self.telemetry.record_request(
+                    queue_wait_s=t_dispatch - r.t_submit,
+                    e2e_s=now - r.t_submit)
+            self._admission.release(r.model)
+
+    def _resolve_dropped(self, r: _Request) -> None:
+        # releaser = whoever resolves the future, exactly once (the
+        # raced-close branch of submit() follows the same rule)
+        try:
+            r.future.set_exception(RuntimeError("engine closed"))
+        except InvalidStateError:
+            return  # already resolved (and released) elsewhere
+        self.telemetry.record_failure()
+        self._admission.release(r.model)
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the dispatcher and join its thread; pending futures fail
+        with RuntimeError('engine closed'). Idempotent."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._paused.clear()
+        self._q.put(_WAKE)
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

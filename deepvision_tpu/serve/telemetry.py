@@ -1,0 +1,141 @@
+"""Serving telemetry: per-request latency histograms + counters.
+
+The serving counterpart of ``data/prefetch.FeedTelemetry``: where the
+feed telemetry attributes *training* feed wall time to pipeline stages,
+:class:`ServeTelemetry` attributes *request* wall time to the serving
+stages — queue wait (admitted → dispatched), pad overhead (the fraction
+of each executed batch that was zero padding up to the bucket), device
+time (the compiled forward), and end-to-end latency — and keeps the
+admission/outcome counters (completed / timed out / shed) that say at a
+glance whether the engine is keeping up with offered load.
+
+Latencies are recorded into bounded reservoirs (a deque of the most
+recent samples) so ``snapshot()`` can report p50/p95/p99 without
+unbounded memory on a long-lived server; totals/counts are exact over
+the process lifetime. All mutation is lock-guarded: ``submit()`` runs on
+caller threads, the dispatcher records on its own thread, and ``/stats``
+readers snapshot from HTTP handler threads.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+__all__ = ["LatencyStats", "ServeTelemetry"]
+
+
+class LatencyStats:
+    """Bounded-reservoir latency series with percentile snapshots.
+
+    ``record`` takes seconds; ``summary`` reports milliseconds. The
+    reservoir keeps the most recent ``maxlen`` samples (enough for
+    stable p99 at serving rates) while ``count``/``total_s`` stay exact.
+    """
+
+    def __init__(self, maxlen: int = 8192):
+        self._samples: deque[float] = deque(maxlen=maxlen)
+        self.count = 0
+        self.total_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        self._samples.append(seconds)
+        self.count += 1
+        self.total_s += seconds
+
+    def summary(self) -> dict:
+        import numpy as np
+
+        if not self._samples:
+            return {"count": self.count, "mean_ms": 0.0, "p50_ms": 0.0,
+                    "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+        arr = np.asarray(self._samples, dtype=np.float64) * 1e3
+        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+        return {
+            "count": self.count,
+            "mean_ms": round(self.total_s / max(1, self.count) * 1e3, 3),
+            "p50_ms": round(float(p50), 3),
+            "p95_ms": round(float(p95), 3),
+            "p99_ms": round(float(p99), 3),
+            "max_ms": round(float(arr.max()), 3),
+        }
+
+
+class ServeTelemetry:
+    """Counters + per-stage histograms for one engine's lifetime."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue_wait = LatencyStats()   # admitted -> batch dispatch
+        self.device_time = LatencyStats()  # compiled forward, per batch
+        self.e2e = LatencyStats()          # admitted -> future resolved
+        # exact counters
+        self.submitted = 0      # admitted into the queue
+        self.completed = 0      # futures resolved with a result
+        self.timed_out = 0      # deadline expired while queued
+        self.failed = 0         # postprocess/forward raised
+        self.shed = 0           # rejected at admission (backpressure)
+        self.batches = 0        # executed device batches
+        self.rows = 0           # real rows across executed batches
+        self.padded_rows = 0    # zero rows added to reach the bucket
+
+    # -- recording (dispatcher + submit threads) -------------------------
+    def record_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timed_out += 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failed += 1
+
+    def record_batch(self, *, bucket: int, rows: int,
+                     device_s: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.rows += rows
+            self.padded_rows += bucket - rows
+            self.device_time.record(device_s)
+
+    def record_request(self, *, queue_wait_s: float, e2e_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.queue_wait.record(queue_wait_s)
+            self.e2e.record(e2e_s)
+
+    # -- reporting -------------------------------------------------------
+    def snapshot(self) -> dict:
+        """One JSON-able dict: counters, pad overhead, and p50/p95/p99
+        blocks per stage (the serving analog of
+        ``FeedTelemetry.summary``)."""
+        with self._lock:
+            executed = self.rows + self.padded_rows
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "timed_out": self.timed_out,
+                "failed": self.failed,
+                "shed": self.shed,
+                "batches": self.batches,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                # fraction of executed device rows that were padding —
+                # high values mean the ladder is too coarse (or traffic
+                # too sparse) for the offered load
+                "pad_overhead_frac": (
+                    round(self.padded_rows / executed, 4) if executed
+                    else 0.0),
+                "mean_batch_rows": (
+                    round(self.rows / self.batches, 2) if self.batches
+                    else 0.0),
+                "queue_wait": self.queue_wait.summary(),
+                "device_time": self.device_time.summary(),
+                "e2e_latency": self.e2e.summary(),
+            }

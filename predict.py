@@ -99,45 +99,31 @@ _PALETTE = [(255, 64, 64), (64, 255, 64), (64, 64, 255), (255, 255, 64),
 
 
 # ------------------------------------------------------ model loading
+# Restore + per-task decode live in deepvision_tpu/serve/models.py so
+# this one-shot CLI and the batched serving engine (serve.py) share ONE
+# code path; the names below are kept as thin delegates.
 
 
 def load_state(model_name: str, workdir: str | None, sample, epoch=None,
                **model_kw):
-    """``epoch``: a specific saved epoch to restore (default latest) —
-    with ``--keep-best`` retention the best checkpoint is often not the
-    newest, so offline eval must be able to target it."""
-    import jax.numpy as jnp
-    import optax
+    """Delegates to ``serve.models.restore_state`` (the shared
+    CLI/server restore path). ``epoch``: a specific saved epoch to
+    restore (default latest)."""
+    from deepvision_tpu.serve.models import restore_state
 
-    from deepvision_tpu.models import get_model
-    from deepvision_tpu.train.state import create_train_state
+    return restore_state(model_name, workdir, sample, epoch, **model_kw)
 
-    model = get_model(model_name, dtype=jnp.float32, **model_kw)
-    # Throwaway tx: restore_inference never touches opt_state, so the
-    # template needn't match the training optimizer (which varies per
-    # config: momentum SGD, adam, plateau-wrapped schedules).
-    state = create_train_state(model, optax.sgd(0.1), sample)
-    if workdir and Path(f"{workdir}/ckpt").exists():
-        from deepvision_tpu.train.checkpoint import CheckpointManager
 
-        mgr = CheckpointManager(f"{workdir}/ckpt")
-        if mgr.latest_epoch() is not None:
-            state, meta = mgr.restore_inference(state, epoch)
-            print(f"restored epoch {meta['epoch']} from {workdir}/ckpt")
-            mgr.close()
-            return state
-        mgr.close()
-    if epoch is not None:
-        # an EXPLICIT epoch request must not silently score random
-        # weights (near-zero metrics recorded as that epoch's result)
-        raise FileNotFoundError(
-            f"requested epoch {epoch} but no checkpoint dir under "
-            f"{workdir!r}")
-    print("no checkpoint found — running freshly initialized weights")
-    return state
+def _model_geometry(model_name: str) -> tuple[int, int]:
+    from deepvision_tpu.serve.models import model_geometry
+
+    return model_geometry(model_name)
 
 
 def _apply(state, images):
+    """Raw eval-mode forward on a restored state — still the building
+    block for evaluate.py's metric loops and the converter tests (the
+    task-decoded paths go through serve.models instead)."""
     variables = {"params": state.params}
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
@@ -147,68 +133,45 @@ def _apply(state, images):
 # --------------------------------------------------------- subcommands
 
 
-def _model_geometry(model_name: str) -> tuple[int, int]:
-    """(input_size, channels) from the model's training config so restored
-    checkpoints see the shapes they were trained with."""
-    from deepvision_tpu.train.configs import TRAINING_CONFIG
-
-    cfg = TRAINING_CONFIG.get(model_name, {})
-    return cfg.get("input_size", 224), cfg.get("channels", 3)
-
-
 def cmd_classify(args):
     from deepvision_tpu.data.metadata import imagenet_label_name
+    from deepvision_tpu.serve.models import (
+        input_scale,
+        load_served,
+        model_geometry,
+    )
 
-    size, channels = _model_geometry(args.model)
-    from deepvision_tpu.train.configs import TRAINING_CONFIG
-
-    lineage = TRAINING_CONFIG.get(
-        args.model.removesuffix("_ref"), {}
-    ).get("augment", "tf")
-    scale = ("unit" if channels == 1
-             else "torch" if lineage == "pt" else "imagenet")
+    size, channels = model_geometry(args.model)
+    scale = input_scale(args.model)
     imgs = [load_image(p, size, scale=scale) for p in args.images]
     if channels == 1:  # grayscale nets (lenet5)
         imgs = [img.mean(axis=-1, keepdims=True) for img in imgs]
-    state = load_state(args.model, args.workdir, imgs[0],
-                       num_classes=args.num_classes)
+    served = load_served(args.model, args.workdir, task="classify",
+                         num_classes=args.num_classes, top_k=args.top)
     for path, img in zip(args.images, imgs):
-        logits = np.asarray(_apply(state, img))
-        if logits.ndim > 2:
-            logits = logits[0]
-        probs = np.exp(logits[0] - logits[0].max())
-        probs /= probs.sum()
-        top = np.argsort(probs)[::-1][: args.top]
-        names = (
-            [imagenet_label_name(i) for i in top]
-            if args.num_classes == 1000 else [str(i) for i in top]
-        )
+        res = served.postprocess(served.run(img), 0)
         print(f"{path}:")
-        for i, name in zip(top, names):
-            print(f"  {probs[i]:6.2%}  {name}")
+        for cls, prob in zip(res["classes"], res["probs"]):
+            name = (imagenet_label_name(cls)
+                    if args.num_classes == 1000 else str(cls))
+            print(f"  {prob:6.2%}  {name}")
 
 
 def cmd_detect(args):
     from deepvision_tpu.data.metadata import class_names
-    from deepvision_tpu.ops.yolo_postprocess import yolo_postprocess
+    from deepvision_tpu.serve.models import load_served
 
     names = class_names(args.names)
     img = load_image(args.images[0], args.size, scale="tanh")
-    state = load_state(args.model, args.workdir, img,
-                       num_classes=len(names))
-    preds = _apply(state, img)
-    boxes, scores, classes, valid, _ = yolo_postprocess(
-        preds, len(names), score_thresh=args.score
-    )
-    boxes = np.asarray(boxes)[0] * args.size  # corners (x1,y1,x2,y2)
-    scores, classes = np.asarray(scores)[0], np.asarray(classes)[0]
-    valid = np.asarray(valid)[0]
+    served = load_served(args.model, args.workdir, task="detect",
+                         input_size=args.size, num_classes=len(names),
+                         score_thresh=args.score)
+    det = served.postprocess(served.run(img), 0)
     canvas = np.clip((img[0] + 1) * 127.5, 0, 255).astype(np.uint8)
     kept = 0
-    for box, score, cls, ok in zip(boxes, scores, classes, valid):
-        if not ok:
-            continue
-        x1, y1, x2, y2 = box
+    for box, score, cls in zip(det["boxes"], det["scores"],
+                               det["classes"]):
+        x1, y1, x2, y2 = (np.asarray(box) * args.size).tolist()
         color = _PALETTE[int(cls) % len(_PALETTE)]
         draw_box(canvas, x1, y1, x2, y2, color)
         print(f"  {names[int(cls)]}: {score:.2f} at "
@@ -219,19 +182,19 @@ def cmd_detect(args):
 
 
 def cmd_pose(args):
+    from deepvision_tpu.serve.models import load_served
+
     img = load_image(args.images[0], args.size, scale="tanh")
-    state = load_state(args.model, args.workdir, img, num_heatmaps=16)
-    heatmaps = np.asarray(_apply(state, img)[-1])[0]  # last stack
+    served = load_served(args.model, args.workdir, task="pose",
+                         input_size=args.size, num_heatmaps=16)
+    res = served.postprocess(served.run(img), 0)
     canvas = np.clip((img[0] + 1) * 127.5, 0, 255).astype(np.uint8)
-    g = heatmaps.shape[0]
-    for j in range(heatmaps.shape[-1]):
-        hm = heatmaps[..., j]
-        y, x = np.unravel_index(np.argmax(hm), hm.shape)
-        if hm[y, x] <= args.score:
+    for j, (x, y, conf) in enumerate(res["joints"]):
+        if conf <= args.score:
             continue
-        draw_dot(canvas, x * args.size / g, y * args.size / g,
+        draw_dot(canvas, x * args.size, y * args.size,
                  _PALETTE[j % len(_PALETTE)])
-        print(f"  joint {j}: ({x}, {y}) conf {hm[y, x]:.2f}")
+        print(f"  joint {j}: ({x:.3f}, {y:.3f}) conf {conf:.2f}")
     save_image(args.output, canvas)
 
 

@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Serving CLI — the batched inference engine behind two zero-dep
+surfaces (``deepvision_tpu/serve/``):
+
+    # stdin-JSONL (default): one JSON request per line, responses to stdout
+    serve.py -m lenet5=runs/lenet5
+    {"id": 1, "model": "lenet5", "input": [[...32x32x1 floats...]]}
+    -> {"id": 1, "model": "lenet5", "result": {...}, "ms": 4.2}
+
+    # HTTP (stdlib http.server, no new deps)
+    serve.py --http 8080 -m resnet50=runs/resnet50 -m yolov3=runs/yolov3
+    POST /v1/predict   {"model": "resnet50", "input": [[...]]}  -> result
+    GET  /stats        engine telemetry + cache + queue snapshot
+    GET  /healthz      "ok" once warmup completed
+
+    # serve a StableHLO artifact from predict.py export
+    serve.py --artifact lenet5=lenet5.stablehlo
+
+``-m name[=workdir]`` is repeatable (multi-model host); every model's
+(bucket) executables compile at startup, so the first request is as
+fast as the thousandth. Saturation returns 429/shed responses with a
+``retry_after`` hint instead of unbounded queueing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from concurrent.futures import TimeoutError as _FutureTimeout
+
+import numpy as np
+
+
+def _parse_spec(spec: str) -> tuple[str, str | None]:
+    name, _, workdir = spec.partition("=")
+    return name, (workdir or None)
+
+
+def build_engine(args):
+    from deepvision_tpu.serve import InferenceEngine, from_stablehlo
+    from deepvision_tpu.serve.models import load_served
+
+    import contextlib
+
+    models = []
+    # restore chatter ("restored epoch N" / "no checkpoint found") goes
+    # to stderr: stdout is the JSONL response stream in --stdin mode
+    with contextlib.redirect_stdout(sys.stderr):
+        for spec in args.model or []:
+            name, workdir = _parse_spec(spec)
+            models.append(load_served(
+                name, workdir, num_classes=args.num_classes,
+                top_k=args.top, score_thresh=args.score))
+        for spec in args.artifact or []:
+            name, path = _parse_spec(spec)
+            if path is None:
+                name, path = None, name
+            models.append(from_stablehlo(path, name=name,
+                                         top_k=args.top))
+    if not models:
+        sys.exit("no models: pass -m NAME[=WORKDIR] or --artifact")
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    mesh, buckets = _serving_mesh(buckets)
+    print(f"serving {[m.name for m in models]} buckets={buckets} "
+          f"on {mesh.devices.size} device(s); compiling...",
+          file=sys.stderr)
+    engine = InferenceEngine(
+        models, mesh=mesh, buckets=buckets, max_queue=args.max_queue,
+        per_model_limit=args.per_model_limit,
+        batch_window_s=args.batch_window_ms / 1e3,
+    )
+    print(f"warmup done in {engine.warmup_s}s "
+          f"({engine.stats()['cache']['entries']} executables)",
+          file=sys.stderr)
+    return engine
+
+
+def _serving_mesh(buckets: tuple[int, ...]):
+    """-> (mesh, ladder) with all devices on the data axis.
+
+    Batches shard over the data axis, so every bucket must divide by
+    the device count — on a multi-chip host the requested ladder is
+    ADAPTED rather than the mesh degraded: buckets below the device
+    count are raised to it, indivisible ones are rounded up to the
+    next multiple (the default 1/4/16/64 on 8 chips becomes 8/16/64).
+    Only a ladder that cannot be adapted (no devices?) falls back to a
+    single-device mesh."""
+    import jax
+
+    from deepvision_tpu.core.mesh import create_mesh
+
+    n = len(jax.devices())
+    if n > 1:
+        adapted = tuple(sorted({((b + n - 1) // n) * n for b in buckets}))
+        if adapted != buckets:
+            print(f"ladder {buckets} adapted to {adapted} for the "
+                  f"{n}-device data axis", file=sys.stderr)
+        return create_mesh(n, 1), adapted
+    return create_mesh(1, 1), buckets
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
+# ---------------------------------------------------------- stdin-JSONL
+
+
+def run_stdin(engine, args, stdin=None, stdout=None):
+    """One JSON request per line; responses (in submission order) to
+    stdout. Requests keep flowing while earlier ones execute, so the
+    dispatcher sees real micro-batches even from a pipe."""
+    import time
+
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    from deepvision_tpu.serve import ShedError
+
+    pending: list[tuple[object, object, float]] = []  # (id, future, t0)
+
+    def emit(rid, fut, t0):
+        try:
+            result = fut.result(timeout=args.timeout_s)
+            line = {"id": rid, "result": _jsonable(result),
+                    "ms": round((time.perf_counter() - t0) * 1e3, 2)}
+        except Exception as e:
+            line = {"id": rid, "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(line), file=stdout, flush=True)
+
+    for raw in stdin:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            req = json.loads(raw)
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+            x = np.asarray(req["input"], np.float32)
+        except (ValueError, KeyError, TypeError) as e:
+            print(json.dumps({"error": f"bad request: {e}"}),
+                  file=stdout, flush=True)
+            continue
+        rid = req.get("id")
+        t0 = time.perf_counter()
+        try:
+            fut = engine.submit(x, model=req.get("model"),
+                                timeout_s=args.timeout_s)
+        except ShedError as e:
+            print(json.dumps({"id": rid, "error": str(e),
+                              "retry_after": e.retry_after_s}),
+                  file=stdout, flush=True)
+            continue
+        except (ValueError, RuntimeError) as e:
+            print(json.dumps({"id": rid, "error": str(e)}),
+                  file=stdout, flush=True)
+            continue
+        pending.append((rid, fut, t0))
+        # bounded in-flight window: keep ~2 ladders' worth queued so
+        # batching happens, without unbounded memory on long streams
+        while len(pending) > 2 * max(engine.buckets):
+            emit(*pending.pop(0))
+    for item in pending:
+        emit(*item)
+
+
+# ----------------------------------------------------------------- HTTP
+
+
+def make_handler(engine, args):
+    """BaseHTTPRequestHandler subclass bound to ``engine`` — factored
+    out of :func:`run_http` so tests can mount it on an ephemeral-port
+    server."""
+    import http.server
+
+    from deepvision_tpu.serve import ShedError
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        # quiet per-request logging; telemetry is the observability
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, payload: dict,
+                  headers: dict | None = None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok",
+                                 "models": engine.stats()["models"]})
+            elif self.path == "/stats":
+                self._send(200, engine.stats())
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path not in ("/v1/predict", "/predict"):
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                if not isinstance(req, dict):
+                    raise ValueError("request must be a JSON object")
+                x = np.asarray(req["input"], np.float32)
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                fut = engine.submit(x, model=req.get("model"),
+                                    timeout_s=args.timeout_s)
+                result = fut.result(timeout=args.timeout_s + 1.0)
+            except ShedError as e:
+                self._send(429, {"error": str(e),
+                                 "retry_after": e.retry_after_s},
+                           {"Retry-After": str(e.retry_after_s)})
+                return
+            # concurrent.futures.TimeoutError (the result-wait timeout)
+            # only aliases builtin TimeoutError from Python 3.11; catch
+            # both so a 3.10 wait-expiry is a 504, not a crashed handler
+            except (TimeoutError, _FutureTimeout) as e:
+                self._send(504, {"error": f"deadline expired: {e}"})
+                return
+            except (ValueError, RuntimeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            self._send(200, {"result": _jsonable(result)})
+
+    return Handler
+
+
+def run_http(engine, args):
+    import http.server
+
+    server = http.server.ThreadingHTTPServer(
+        ("", args.http), make_handler(engine, args))
+    print(f"listening on :{args.http} "
+          f"(POST /v1/predict, GET /stats, GET /healthz)",
+          file=sys.stderr)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--model", action="append",
+                   help="NAME[=WORKDIR], repeatable (multi-model host)")
+    p.add_argument("--artifact", action="append",
+                   help="[NAME=]PATH to a StableHLO export, repeatable")
+    p.add_argument("--http", type=int, default=None,
+                   help="HTTP port (default: stdin-JSONL mode)")
+    p.add_argument("--buckets", default="1,4,16,64",
+                   help="batch bucket ladder (comma-separated)")
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--per-model-limit", type=int, default=None)
+    p.add_argument("--batch-window-ms", type=float, default=2.0,
+                   help="wait this long for a bucket to fill before "
+                        "running a padded partial batch")
+    p.add_argument("--timeout-s", type=float, default=30.0,
+                   help="per-request deadline")
+    p.add_argument("--num-classes", type=int, default=None)
+    p.add_argument("--top", type=int, default=5)
+    p.add_argument("--score", type=float, default=0.5)
+    args = p.parse_args(argv)
+
+    engine = build_engine(args)
+    try:
+        if args.http is not None:
+            run_http(engine, args)
+        else:
+            run_stdin(engine, args)
+    finally:
+        engine.close()
+
+
+if __name__ == "__main__":
+    main()

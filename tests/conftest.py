@@ -198,6 +198,11 @@ _SLOW_TESTS = {
     "test_sigterm_with_concurrent_resume_subprocess",
     "test_echo_multiplies_steps_and_learns",
     "test_inception_converter_main_logits_match",
+    # serving (PR 3): the real-model heavy checks — yolo+hourglass
+    # compiles and the 256-request saturation run; the lenet e2e smoke
+    # and the toy-model engine tests stay in the fast tier
+    "test_detect_and_pose_heads_padded_match_single",
+    "test_serve_saturation_throughput_vs_sequential",
 }
 # whole modules that spawn real subprocesses (jax.distributed workers)
 _SLOW_MODULES = {"test_distributed"}

@@ -525,6 +525,81 @@ def test_jx109_passes_deferred_fetch_and_plain_loops(tmp_path):
     assert codes(r) == []
 
 
+# ----------------------------------------------------------- JX110
+
+
+def test_jx110_flags_jit_in_request_loop(tmp_path):
+    r = lint(tmp_path, "lib/server.py", """
+        import jax
+        from jax.experimental.pjit import pjit
+
+        def handle_requests(q, params):
+            while True:
+                x = q.get()
+                # per-request trace+compile: seconds of latency where
+                # steady state is milliseconds
+                y = jax.jit(lambda p, a: p @ a)(params, x)
+                z = pjit(lambda a: a + 1)(x)
+                q.task_done()
+        """)
+    assert codes(r) == ["JX110", "JX110"]
+    assert "request loop" in r.findings[0].message
+
+
+def test_jx110_passes_hoisted_jit_and_non_serve_functions(tmp_path):
+    r = lint(tmp_path, "lib/server.py", """
+        import jax
+
+        def serve_loop(q, params):
+            fwd = jax.jit(lambda p, a: p @ a)   # hoisted: traces once
+            while True:
+                x = q.get()
+                y = fwd(params, x)
+
+        def build_steps(fns):
+            # jit in a loop is fine OUTSIDE request-handling functions
+            # (e.g. warmup compiles every bucket eagerly, by design)
+            return [jax.jit(f) for f in fns]
+
+        def warmup_all(models):
+            out = []
+            for m in models:
+                out.append(jax.jit(m))
+            return out
+        """)
+    assert codes(r) == []
+
+
+def test_jx110_serve_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(serve_funcs=["rpc_*"])
+    r = lint(tmp_path, "lib/server.py", """
+        import jax
+
+        def rpc_loop(q):
+            for x in q:
+                y = jax.jit(lambda a: a + 1)(x)
+
+        def handle_requests(q):
+            for x in q:                       # not matched by the knob
+                y = jax.jit(lambda a: a + 1)(x)
+        """, cfg=cfg)
+    assert codes(r) == ["JX110"]
+
+
+def test_load_config_reads_serve_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        serve_funcs = ["rpc_*", "*worker*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.serve_funcs == ["rpc_*", "*worker*"]
+    # defaults cover the repo's own serving layer naming
+    assert "*dispatch*" in LintConfig().serve_funcs
+
+
 # ------------------------------------------- suppression + baseline
 
 

@@ -778,3 +778,49 @@ class PrefetchLoopSyncChecker(Checker):
             if isinstance(node, ast.Name) and node.id in names:
                 return True
         return False
+
+
+@register_checker
+class ServeRetraceChecker(Checker):
+    """``jax.jit``/``pjit`` *called* inside a request-handling loop:
+    every new input shape (or simply every fresh jit object) pays a full
+    trace+compile on the request path — latency spikes of seconds where
+    the steady state is milliseconds. Serving code must hit
+    pre-compiled executables (``serve/compile_cache.py``: pad to a
+    bucket ladder, compile once per (model, bucket) at warmup). Which
+    functions count as request loops is the ``serve_funcs`` knob
+    (name patterns, ``jaxlint.toml``)."""
+
+    code = "JX110"
+    name = "jit-in-request-loop"
+    description = ("jax.jit/pjit called inside a request-handling loop "
+                   "(per-request retrace/compile hazard)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.serve_funcs
+        flagged: set[int] = set()  # nested loops: report a call once
+        for info in mod.functions:
+            if not any(fnmatch.fnmatch(info.node.name, p)
+                       for p in patterns):
+                continue
+            for loop in ast.walk(info.node):
+                if not isinstance(loop, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                    continue
+                for stmt in loop.body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call) \
+                                or id(sub) in flagged:
+                            continue
+                        la = last_attr(call_name(sub))
+                        if la in ("jit", "pjit"):
+                            flagged.add(id(sub))
+                            yield mod.finding(
+                                sub, self.code,
+                                f"'{call_name(sub)}' inside the "
+                                f"request loop of '{info.node.name}' "
+                                "traces+compiles on the request path; "
+                                "hoist it out of the loop (or serve "
+                                "from a warmed shape-bucketed "
+                                "executable cache, serve/"
+                                "compile_cache.py)")

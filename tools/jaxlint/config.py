@@ -242,6 +242,12 @@ class LintConfig:
     prefetch_funcs: list[str] = field(default_factory=lambda: [
         "device_prefetch", "DevicePrefetcher", "prefetch_to_device",
     ])
+    # Function-name patterns treated as request-handling loops (JX110):
+    # a jax.jit/pjit call inside a loop there traces+compiles on the
+    # request path instead of hitting a warmed executable cache.
+    serve_funcs: list[str] = field(default_factory=lambda: [
+        "*serve*", "*dispatch*", "*handle*", "*request_loop*",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -260,7 +266,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "traced_dirs", "data_dirs", "parallel_dirs",
         "traced_name_patterns", "jit_wrappers", "static_return_calls",
         "key_fresheners", "key_name_patterns", "constraint_funcs",
-        "prefetch_funcs", "disable",
+        "prefetch_funcs", "serve_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))
