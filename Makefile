@@ -64,11 +64,27 @@ serve-smoke:
 	    assert len(ok) == 3, rows; \
 	    print('serve-smoke OK (3/3 responses)')"
 
-# the default CI path: hazard lint + serving smoke + whole-zoo shape
-# gate + full suite (the suite's own full-registry evalcheck test is
-# deselected — `lint` above just ran the identical ~2-min gate via the
-# CLI)
-check: lint serve-smoke
+# chaos smoke: a scripted fault schedule on the lenet synthetic config —
+# one NaN step (epoch-2 batch 2), one corrupt checkpoint (the epoch-1
+# save, i.e. the rollback's first restore candidate), and two transient
+# data-read errors — must complete (exit 0) WITH the expected recovery
+# counters in the log: the `make check` self-healing gate
+# (deepvision_tpu/resilience/; drop --recover to watch it fail fast)
+chaos-smoke:
+	@mkdir -p logs; L="logs/chaos-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	rm -rf runs/chaos-smoke; \
+	$(PY) train.py -m lenet5 --platform cpu --epochs 3 \
+		--synthetic-size 512 --batch-size 64 --steps-per-epoch 6 \
+		--recover --faults "nan@14,ckpt@1,io@8x2" \
+		--workdir runs/chaos-smoke 2>&1 | tee "$$L" && \
+	grep -q "rollbacks=1 ckpt_fallbacks=1 data_retries=2" "$$L" && \
+	echo "chaos-smoke OK (recovered: rollback + ckpt fallback + retries)"
+
+# the default CI path: hazard lint + serving smoke + chaos smoke +
+# whole-zoo shape gate + full suite (the suite's own full-registry
+# evalcheck test is deselected — `lint` above just ran the identical
+# ~2-min gate via the CLI)
+check: lint serve-smoke chaos-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 

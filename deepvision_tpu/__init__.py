@@ -24,6 +24,13 @@ as ONE shared library instead of per-model copy-paste:
                  diffing + hash-verified pretrained ingestion.
 - ``eval``     : offline metrics (detection mAP, pose PCK) the reference
                  never shipped.
+- ``serve``    : batched inference runtime (bucketed AOT executable
+                 cache, admission control, serving telemetry) behind the
+                 ``serve.py`` stdin-JSONL/HTTP CLI.
+- ``resilience``: deterministic fault injection + bounded recovery
+                 (NaN-rollback, checkpoint integrity manifests with
+                 quarantine/fallback, transient-read retries, supervised
+                 serve dispatcher).
 
 Reference behavior is cited throughout as ``ref: <file:line>`` meaning a
 path under the upstream `deep-vision` repo.

@@ -128,6 +128,21 @@ def compile_eval_step(step_fn, mesh: Mesh, *, batch_spec: P | None = None,
     )
 
 
+def checkify_error_cls() -> type[BaseException]:
+    """The exception class the NaN/Inf tripwire raises
+    (``checkify.check_error`` inside :func:`compile_checked_train_step`'s
+    runner) — the one symbol recovery code needs to catch it NARROWLY.
+    Catching broadly around a compiled step would also swallow real
+    device/runtime failures (jaxlint JX111 flags exactly that), so the
+    class is exported here instead of every consumer reaching into
+    ``jax.experimental.checkify``. Resolved lazily: except clauses
+    evaluate their expression only while an exception is in flight, so
+    the unchecked hot path never pays the import."""
+    from jax.experimental import checkify as ck
+
+    return ck.JaxRuntimeError
+
+
 def compile_checked_train_step(
     step_fn: TrainStepFn,
     mesh: Mesh,

@@ -122,7 +122,15 @@ class DevicePrefetcher:
 
     def __init__(self, batches: Iterable, mesh, *, depth: int = 2,
                  shard_fn: Callable | None = None,
-                 telemetry: FeedTelemetry | None = None):
+                 telemetry: FeedTelemetry | None = None,
+                 fault_injector=None, retry_policy=None,
+                 retry_counters=None):
+        """``retry_policy`` (``resilience.RecoveryPolicy``): transient
+        ``OSError`` from the upstream pull is retried with bounded
+        exponential backoff (counted in ``retry_counters.data_retries``)
+        instead of killing the epoch — at pod scale a blipped storage
+        read is routine, not fatal. ``fault_injector`` consults the
+        deterministic ``data_io`` chaos site before each pull."""
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         if shard_fn is None:
@@ -130,6 +138,9 @@ class DevicePrefetcher:
 
             shard_fn = lambda b: shard_batch(mesh, b)  # noqa: E731
         self._shard = shard_fn
+        self._injector = fault_injector
+        self._retry_policy = retry_policy
+        self._retry_counters = retry_counters
         self._src = iter(batches)
         self.telemetry = telemetry if telemetry is not None \
             else FeedTelemetry()
@@ -149,7 +160,7 @@ class DevicePrefetcher:
             while not self._stop.is_set():
                 t0 = time.perf_counter()
                 try:
-                    batch = next(self._src)
+                    batch = self._next_batch()
                 except StopIteration:
                     self._put((_DONE, None))
                     return
@@ -161,6 +172,59 @@ class DevicePrefetcher:
                     return  # closed while we waited for queue space
         except BaseException as e:  # re-raised at the consumer's next pull
             self._put((_ERROR, e))
+
+    def _next_batch(self):
+        """One upstream pull, with the chaos hook and bounded transient-
+        retry semantics from the ctor docstring. The injector consult
+        runs BEFORE ``next`` so an injected failure never consumes a
+        batch — a retried pull preserves the deterministic data order."""
+        policy = self._retry_policy
+        attempt = 0
+        pull_errored = False  # did an OSError come from next() itself?
+        last_err: OSError | None = None
+
+        def admit_retry(e: OSError) -> None:
+            nonlocal attempt, last_err
+            if policy is None or attempt >= policy.max_data_retries:
+                raise e
+            last_err = e
+            if self._retry_counters is not None:
+                self._retry_counters.inc("data_retries")
+            delay = policy.backoff(attempt)
+            attempt += 1
+            print(f"[data-retry] transient batch read error ({e}); "
+                  f"retry {attempt}/{policy.max_data_retries} "
+                  f"in {delay:.2f}s", flush=True)
+            # stop-responsive backoff: close()/preemption must not ride
+            # out the delay (or fire one more post-stop read)
+            if self._stop.wait(delay):
+                raise e
+
+        while True:
+            try:
+                if self._injector is not None:
+                    self._injector.check_io()
+            except OSError as e:
+                # pre-pull failure: the source is untouched, so a retry
+                # is always sound — even on the exhaustion pull (the
+                # retried next() then reports a CLEAN end of epoch)
+                admit_retry(e)
+                continue
+            try:
+                return next(self._src)
+            except StopIteration:
+                if pull_errored:
+                    # a GENERATOR source that raised inside next() is
+                    # closed: the retried pull reports exhaustion, which
+                    # would silently truncate the epoch and let the run
+                    # train on partial data — surface the real failure
+                    # (only sources whose __next__ is itself retryable
+                    # can be rescued once the pull has errored)
+                    raise last_err
+                raise
+            except OSError as e:
+                pull_errored = True
+                admit_retry(e)
 
     def _put(self, item) -> bool:
         """Backpressured enqueue that stays responsive to close()."""

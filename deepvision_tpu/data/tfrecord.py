@@ -91,9 +91,18 @@ def write_records(path: str | Path, records: list[bytes]) -> None:
             f.write(struct.pack("<I", _masked_crc(rec)))
 
 
-def read_records(path: str | Path, *, verify: bool = True) -> Iterator[bytes]:
+def read_records(path: str | Path, *, verify: bool = True,
+                 fault_injector=None) -> Iterator[bytes]:
+    """``fault_injector`` (``resilience.FaultInjector``): the ``data_io``
+    chaos site is consulted before every record read, so TFRecord-fed
+    pipelines get the same deterministic transient-failure drills as the
+    in-memory paths (the retry lives in the consumer — a generator that
+    raised cannot be resumed, so injection happens per-record here and
+    recovery wraps the pull, e.g. ``data/prefetch.DevicePrefetcher``)."""
     with open(path, "rb") as f:
         while True:
+            if fault_injector is not None:
+                fault_injector.check_io(what=f"record read ({path})")
             header = f.read(8)
             if not header:
                 return

@@ -1,0 +1,58 @@
+"""deepvision_tpu.resilience — self-healing training & serving.
+
+The ROADMAP north star is a production-scale system; at that scale
+preemption, transient I/O, bit-rot, and numeric blow-ups are routine
+events to recover from, not fatal errors (PAPERS.md "Scale MLPerf-0.6
+models on Google TPU-v3 Pods" treats them as the steady state). Every
+failure path in the framework used to be fail-fast: the checkify
+NaN/Inf tripwire killed the run, a corrupt checkpoint crashed
+``Trainer.resume()``, and a dispatcher-loop crash stranded every queued
+future. This package adds the recovery layer plus the deterministic
+fault-injection harness needed to TEST it on CPU:
+
+- ``faults``   : :class:`FaultInjector` — a deterministic, occurrence-
+                 scheduled (or seed-scheduled probabilistic) injector of
+                 NaN steps, transient data-read ``IOError``, on-disk
+                 checkpoint corruption, stalled steps, and dispatcher
+                 crashes. Trainer / data / checkpoint / serve layers
+                 consult it through injectable hooks, so chaos tests
+                 replay bit-identically.
+- ``recovery`` : :class:`RecoveryPolicy` (bounded retries, exponential
+                 backoff, rollback budget) + :class:`RecoveryCounters`
+                 (rollbacks / ckpt_fallbacks / data_retries, surfaced
+                 per epoch through ``train/loggers.Loggers``).
+
+Consumers: ``train/trainer.py`` (NaN tripwire -> checkpoint rollback +
+batch-window skip), ``train/checkpoint.py`` (per-save checksum
+manifests, verify-quarantine-fallback resume), ``data/prefetch.py``
+(bounded transient-read retries), ``serve/engine.py`` (supervised
+dispatcher with crash containment + backoff restart).
+"""
+
+from deepvision_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedCrash,
+    InjectedIOError,
+    parse_schedule,
+    poison_batch,
+)
+from deepvision_tpu.resilience.recovery import (
+    NumericDivergence,
+    RecoveryCounters,
+    RecoveryError,
+    RecoveryPolicy,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedCrash",
+    "InjectedIOError",
+    "parse_schedule",
+    "poison_batch",
+    "NumericDivergence",
+    "RecoveryCounters",
+    "RecoveryError",
+    "RecoveryPolicy",
+]

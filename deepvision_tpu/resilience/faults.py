@@ -1,0 +1,272 @@
+"""Deterministic fault injection for chaos tests (and drills).
+
+A :class:`FaultInjector` holds a parsed schedule of :class:`FaultSpec`s
+and a per-site consultation counter. Each layer that can fail consults
+its site hook at a well-defined point; the injector fires a spec when
+the site's occurrence counter enters the spec's window. Because the
+counters advance one per consultation and every consumer consults at a
+deterministic program point, a schedule replays bit-identically on CPU
+— the property the chaos matrix in ``tests/test_resilience.py`` leans
+on. Probabilistic specs (``kind~p``) draw from a seeded generator
+instead, for soak-style drills.
+
+Schedule grammar (comma-separated specs)::
+
+    kind@AT[xTIMES][:ARG]     fire at site occurrences [AT, AT+TIMES)
+    kind~PROB[:ARG]           fire with probability PROB per consult
+
+Sites and their consultation points:
+
+==================  =====================================================
+``nan_step``        per train batch yielded to the feed (Trainer); fires
+                    by NaN-poisoning the batch so the checkify tripwire
+                    raises inside the compiled step. Aliases: ``nan``,
+                    ``nan_grad``.
+``data_io``         per upstream pull in the prefetch producer
+                    (``data/prefetch.py``) and per record read in
+                    ``data/tfrecord.read_records``; fires by raising
+                    :class:`InjectedIOError`. Alias: ``io``.
+``ckpt_corrupt``    per committed checkpoint save
+                    (``train/checkpoint.py``); fires by garbling the
+                    largest file of the just-saved epoch on disk.
+                    Alias: ``ckpt``.
+``stall``           per train batch yielded to the feed; fires by
+                    sleeping ``ARG`` seconds (default 1.0) — trips the
+                    stall watchdog.
+``dispatch_crash``  per dispatched serve batch (``serve/engine.py``);
+                    fires by raising :class:`InjectedCrash` in the
+                    dispatcher loop body. Alias: ``crash``.
+==================  =====================================================
+
+Example: ``"nan@14,ckpt@1,io@8x2"`` — NaN-poison the 15th train batch,
+corrupt the 2nd checkpoint save, and fail the 9th and 10th data pulls
+with transient read errors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+__all__ = [
+    "FaultSpec",
+    "FaultInjector",
+    "InjectedIOError",
+    "InjectedCrash",
+    "parse_schedule",
+    "poison_batch",
+]
+
+# canonical site names + accepted aliases
+SITES = ("nan_step", "data_io", "ckpt_corrupt", "stall", "dispatch_crash")
+_ALIASES = {
+    "nan": "nan_step", "nan_grad": "nan_step",
+    "io": "data_io",
+    "ckpt": "ckpt_corrupt",
+    "crash": "dispatch_crash",
+}
+
+
+class InjectedIOError(IOError):
+    """A scheduled transient data-read failure (retryable)."""
+
+
+class InjectedCrash(RuntimeError):
+    """A scheduled unexpected dispatcher/loop crash."""
+
+
+@dataclass
+class FaultSpec:
+    """One scheduled fault: fires at site occurrences
+    ``[at, at + times)`` — or, when ``prob`` is set, with probability
+    ``prob`` on every consult. ``arg`` carries a per-kind parameter
+    (stall duration in seconds)."""
+
+    kind: str
+    at: int | None = None
+    times: int = 1
+    prob: float | None = None
+    arg: float | None = None
+    fired: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        self.kind = _ALIASES.get(self.kind, self.kind)
+        if self.kind not in SITES:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; expected one of "
+                f"{SITES} (aliases {sorted(_ALIASES)})")
+        if (self.at is None) == (self.prob is None):
+            raise ValueError(
+                f"{self.kind}: exactly one of at= / prob= required")
+        if self.prob is not None and not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"{self.kind}: prob must be in [0,1], "
+                             f"got {self.prob}")
+        if self.times < 1:
+            raise ValueError(f"{self.kind}: times must be >= 1, "
+                             f"got {self.times}")
+
+    def should_fire(self, occurrence: int, rng) -> bool:
+        if self.prob is not None:
+            return bool(rng.random() < self.prob)
+        return self.at <= occurrence < self.at + self.times
+
+
+def parse_schedule(spec: str) -> list[FaultSpec]:
+    """Parse the schedule grammar (module docstring) into specs."""
+    out: list[FaultSpec] = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        arg = None
+        if ":" in raw:
+            raw, _, argtok = raw.partition(":")
+            try:
+                arg = float(argtok)
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {raw!r}: bad :ARG value {argtok!r}")
+        if "@" in raw:
+            kind, _, attok = raw.partition("@")
+            times = 1
+            if "x" in attok:
+                attok, _, timestok = attok.partition("x")
+                times = _parse_int(timestok, raw, "xTIMES")
+            out.append(FaultSpec(kind=kind.strip(),
+                                 at=_parse_int(attok, raw, "@AT"),
+                                 times=times, arg=arg))
+        elif "~" in raw:
+            kind, _, ptok = raw.partition("~")
+            try:
+                prob = float(ptok)
+            except ValueError:
+                raise ValueError(f"fault spec {raw!r}: bad ~PROB "
+                                 f"value {ptok!r}") from None
+            out.append(FaultSpec(kind=kind.strip(), prob=prob, arg=arg))
+        else:
+            raise ValueError(
+                f"fault spec {raw!r}: expected kind@AT[xN][:ARG] "
+                "or kind~PROB[:ARG]")
+    return out
+
+
+def _parse_int(tok: str, raw: str, what: str) -> int:
+    try:
+        return int(tok)
+    except ValueError:
+        raise ValueError(
+            f"fault spec {raw!r}: bad {what} value {tok!r}") from None
+
+
+def poison_batch(batch: dict) -> dict:
+    """NaN-fill the first float array of ``batch`` (a shallow COPY —
+    synthetic datasets yield views of one resident array, and an
+    in-place write would poison every later epoch too)."""
+    out = dict(batch)
+    for k, v in out.items():
+        arr = np.asarray(v)
+        if np.issubdtype(arr.dtype, np.floating):
+            out[k] = np.full_like(arr, np.nan)
+            return out
+    # integer-only batch (uint8 wire formats): poison via float cast so
+    # the step's normalization still produces NaN activations
+    k = next(iter(out))
+    out[k] = np.full(np.asarray(out[k]).shape, np.nan, np.float32)
+    return out
+
+
+class FaultInjector:
+    """Thread-safe, occurrence-counted fault oracle.
+
+    ``schedule`` is a grammar string or an iterable of
+    :class:`FaultSpec`. Each site hook below increments that site's
+    counter once per consultation and fires any spec whose window the
+    counter entered; fired faults are recorded (``fired`` /
+    :meth:`summary`) so tests and logs can assert exactly what was
+    injected. Counters are monotonic across rollbacks/retries — a
+    consumed occurrence never re-fires, which is what makes "inject one
+    NaN step, recover, converge" a well-posed test.
+    """
+
+    def __init__(self, schedule: str | list[FaultSpec] | None,
+                 *, seed: int = 0):
+        if isinstance(schedule, str):
+            schedule = parse_schedule(schedule)
+        self.specs: list[FaultSpec] = list(schedule or [])
+        self._rng = np.random.default_rng(seed)
+        self._counts: dict[str, int] = {s: 0 for s in SITES}
+        self._lock = threading.Lock()
+        self.fired: list[tuple[str, int]] = []  # (site, occurrence)
+
+    def _consult(self, site: str) -> FaultSpec | None:
+        """Advance ``site``'s counter; return the spec to fire, if any."""
+        with self._lock:
+            occ = self._counts[site]
+            self._counts[site] = occ + 1
+            for spec in self.specs:
+                if spec.kind == site and spec.should_fire(occ, self._rng):
+                    spec.fired += 1
+                    self.fired.append((site, occ))
+                    return spec
+        return None
+
+    # -- site hooks ------------------------------------------------------
+    def poison_nan(self, batch: dict) -> tuple[dict, bool]:
+        """Trainer hook, per yielded train batch: -> (batch, fired)."""
+        spec = self._consult("nan_step")
+        if spec is None:
+            return batch, False
+        return poison_batch(batch), True
+
+    def check_io(self, what: str = "data read") -> None:
+        """Data-layer hook: raise a transient read error when scheduled."""
+        spec = self._consult("data_io")
+        if spec is not None:
+            raise InjectedIOError(
+                f"injected transient {what} failure "
+                f"(occurrence {self._counts['data_io'] - 1})")
+
+    def maybe_stall(self, *, sleep=time.sleep) -> bool:
+        """Trainer hook: sleep through a scheduled stall (watchdog food)."""
+        spec = self._consult("stall")
+        if spec is None:
+            return False
+        sleep(spec.arg if spec.arg is not None else 1.0)
+        return True
+
+    def check_dispatch(self) -> None:
+        """Serve hook, per dispatched batch: crash the loop body when
+        scheduled."""
+        spec = self._consult("dispatch_crash")
+        if spec is not None:
+            raise InjectedCrash(
+                "injected dispatcher crash "
+                f"(occurrence {self._counts['dispatch_crash'] - 1})")
+
+    def corrupt_checkpoint(self, step_dir: str | Path) -> bool:
+        """Checkpoint hook, per committed save: garble the largest file
+        under ``step_dir`` (the array payload — guarantees both a
+        checksum mismatch and, without verification, a restore crash)."""
+        spec = self._consult("ckpt_corrupt")
+        if spec is None:
+            return False
+        step_dir = Path(step_dir)
+        files = sorted((p for p in step_dir.rglob("*") if p.is_file()),
+                       key=lambda p: (p.stat().st_size, str(p)))
+        if not files:
+            return False
+        victim = files[-1]
+        victim.write_bytes(b"\x00injected-corruption\x00")
+        print(f"[fault] corrupted checkpoint file {victim}", flush=True)
+        return True
+
+    # -- reporting -------------------------------------------------------
+    def summary(self) -> str:
+        with self._lock:
+            if not self.fired:
+                return "no faults fired"
+            return " ".join(f"{site}@{occ}" for site, occ in self.fired)

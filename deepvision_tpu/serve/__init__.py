@@ -5,7 +5,11 @@ The serving runtime layer (ROADMAP north star: "serves heavy traffic"):
 - ``engine``        : background dispatcher draining a bounded request
                       queue into padded, bucket-laddered micro-batches
                       over pre-compiled mesh-sharded executables, with
-                      per-request futures + deadline support.
+                      per-request futures + deadline support; runs
+                      under a crash supervisor (resilience/) that fails
+                      pending futures on an unexpected loop crash,
+                      restarts with backoff, and degrades ``health()``
+                      (``/healthz`` 503) while recovering.
 - ``compile_cache`` : LRU of AOT-compiled executables keyed by
                       (model, bucket, dtype), eagerly warmed so no
                       request pays a trace.

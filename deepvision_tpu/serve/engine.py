@@ -28,6 +28,14 @@ style):
 - **clean shutdown** — ``close()`` stops and joins the dispatcher and
   fails any still-pending futures; no threads or orphaned requests
   leak.
+- **crash containment** — the dispatcher runs under a supervisor
+  (``_supervise``): an unexpected exception in the loop body fails
+  every queued AND in-flight future with the error immediately (no
+  client ever hangs until deadline expiry), is counted in telemetry
+  (``dispatcher_crashes``/``dispatcher_restarts``), and the loop
+  restarts with capped exponential backoff while :meth:`health`
+  degrades to ``"recovering"`` (``/healthz`` serves 503) — the
+  resilience/ contract: recover from routine faults, loudly.
 
 Every request resolves a ``concurrent.futures.Future``; telemetry
 (``telemetry.ServeTelemetry``) attributes each request's wall time to
@@ -37,6 +45,7 @@ queue-wait / device-time / e2e and tracks the pad overhead per batch.
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
@@ -92,6 +101,9 @@ class InferenceEngine:
         warmup: bool = True,
         cache_entries: int = 64,
         telemetry: ServeTelemetry | None = None,
+        fault_injector=None,
+        restart_backoff_s: float = 0.05,
+        restart_backoff_max_s: float = 5.0,
     ):
         if isinstance(models, dict):
             self._models = dict(models)
@@ -120,12 +132,24 @@ class InferenceEngine:
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._paused = threading.Event()
+        # dispatcher supervision state: per-model backlog + the batch
+        # currently in the loop's hands live on the INSTANCE so a crash
+        # handler can fail every one of their futures (a local would
+        # strand them un-resolvable — clients hang to deadline expiry)
+        self._pending: dict[str, list[_Request]] = {
+            name: [] for name in self._models}
+        self._in_flight: list[_Request] = []
+        self._recovering = threading.Event()
+        self._injector = fault_injector
+        self._restart_backoff_s = restart_backoff_s
+        self._restart_backoff_max_s = restart_backoff_max_s
+        self._backoff_reset_s = 5.0  # healthy-for-this-long resets backoff
         self.warmup_s = 0.0
         self._replicate_variables()
         if warmup:
             self.warm()
         self._thread = threading.Thread(
-            target=self._dispatch_loop, name="serve-dispatch", daemon=True
+            target=self._supervise, name="serve-dispatch", daemon=True
         )
         self._thread.start()
 
@@ -223,9 +247,23 @@ class InferenceEngine:
             "models": sorted(self._models),
             "buckets": list(self.buckets),
             "warmup_s": self.warmup_s,
+            "health": self.health(),
             "queue": self._admission.stats(),
             "cache": self._cache.stats(),
             "telemetry": self.telemetry.snapshot(),
+        }
+
+    def health(self) -> dict:
+        """Liveness for ``/healthz``: ``"recovering"`` while the
+        supervisor sits in a post-crash backoff window (the CLI serves
+        503 then — load balancers should drain, not route), ``"ok"``
+        otherwise. Crash/restart counts ride along so a probe can tell
+        self-healed from never-faulted."""
+        recovering = self._recovering.is_set()
+        return {
+            "status": "recovering" if recovering else "ok",
+            "dispatcher_crashes": self.telemetry.dispatcher_crashes,
+            "dispatcher_restarts": self.telemetry.dispatcher_restarts,
         }
 
     # pause/resume: used by drains and tests that need deterministic
@@ -239,9 +277,46 @@ class InferenceEngine:
         self._q.put(_WAKE)
 
     # -- dispatcher ------------------------------------------------------
+    def _supervise(self) -> None:
+        """Run the dispatch loop under crash supervision: an unexpected
+        exception (anything ``_run_batch``'s per-batch containment did
+        not absorb) fails every queued and in-flight future with the
+        error — immediately, not at deadline expiry — then the loop
+        restarts after a capped exponential backoff. ``health()``
+        reports ``"recovering"`` for the backoff window. Backoff resets
+        once a loop incarnation survives ``_backoff_reset_s``, so an
+        engine that crashes once a day never escalates to max delay."""
+        backoff = self._restart_backoff_s
+        while True:
+            t0 = time.monotonic()
+            try:
+                self._dispatch_loop()
+                return  # clean close(): loop drained and exited
+            except BaseException as e:
+                self.telemetry.record_dispatcher_crash()
+                n = self._fail_all_pending(RuntimeError(
+                    f"dispatcher crashed: {type(e).__name__}: {e}"))
+                print(f"[serve-supervisor] dispatcher crashed "
+                      f"({type(e).__name__}: {e}); failed {n} pending "
+                      f"request(s); restarting in {backoff:.2f}s",
+                      file=sys.stderr, flush=True)
+                if self._stop.is_set():
+                    # closing: drain anything submitted since the crash
+                    self._fail_all_pending(RuntimeError("engine closed"))
+                    return
+                if time.monotonic() - t0 > self._backoff_reset_s:
+                    backoff = self._restart_backoff_s
+                self._recovering.set()
+                self._stop.wait(backoff)  # close() wakes this instantly
+                self._recovering.clear()
+                if self._stop.is_set():
+                    self._fail_all_pending(RuntimeError("engine closed"))
+                    return
+                backoff = min(backoff * 2, self._restart_backoff_max_s)
+                self.telemetry.record_dispatcher_restart()
+
     def _dispatch_loop(self) -> None:
-        pending: dict[str, list[_Request]] = {
-            name: [] for name in self._models}
+        pending = self._pending
         rr = list(self._models)  # round-robin cursor over models
         while not self._stop.is_set():
             if self._paused.is_set():
@@ -259,15 +334,50 @@ class InferenceEngine:
             self._fill_window(pending, name, ladder_max)
             reqs = pending[name][:ladder_max]
             del pending[name][:ladder_max]
+            # visible to the crash handler from the moment they leave
+            # the backlog: a crash anywhere past the slice (deadline
+            # expiry included) must fail THESE futures too, or their
+            # clients hang and their admission slots leak
+            self._in_flight = reqs
             live = self._expire(reqs)
             if live:
+                self._in_flight = live
+                if self._injector is not None:
+                    self._injector.check_dispatch()  # chaos site
                 self._run_batch(served, live)
+            self._in_flight = []
         # drain: fail anything still queued/pending so no caller blocks
         # forever on a future the dispatcher will never resolve
         self._drain_inbound(pending, block=False)
         for reqs in pending.values():
             for r in reqs:
                 self._resolve_dropped(r)
+            reqs.clear()
+
+    def _fail_all_pending(self, exc: BaseException) -> int:
+        """Resolve every queued + in-flight future with ``exc`` (counted
+        as failures, admission slots released); -> how many."""
+        n = 0
+        self._drain_inbound(self._pending, block=False)
+        for r in self._in_flight:
+            n += self._fail_request(r, exc)
+        self._in_flight = []
+        for reqs in self._pending.values():
+            for r in reqs:
+                n += self._fail_request(r, exc)
+            reqs.clear()
+        return n
+
+    def _fail_request(self, r: _Request, exc: BaseException) -> int:
+        # releaser = whoever resolves the future, exactly once (the
+        # raced-close branch of submit() follows the same rule)
+        try:
+            r.future.set_exception(exc)
+        except InvalidStateError:
+            return 0  # already resolved (and released) elsewhere
+        self.telemetry.record_failure()
+        self._admission.release(r.model)
+        return 1
 
     def _drain_inbound(self, pending, block: bool) -> None:
         try:
@@ -316,9 +426,12 @@ class InferenceEngine:
         live = []
         for r in reqs:
             if r.deadline is not None and now > r.deadline:
-                r.future.set_exception(TimeoutError(
-                    f"deadline expired after "
-                    f"{now - r.t_submit:.3f}s in queue"))
+                try:
+                    r.future.set_exception(TimeoutError(
+                        f"deadline expired after "
+                        f"{now - r.t_submit:.3f}s in queue"))
+                except InvalidStateError:
+                    continue  # raced close() resolved (and released) it
                 self.telemetry.record_timeout()
                 self._admission.release(r.model)
             else:
@@ -374,14 +487,7 @@ class InferenceEngine:
             self._admission.release(r.model)
 
     def _resolve_dropped(self, r: _Request) -> None:
-        # releaser = whoever resolves the future, exactly once (the
-        # raced-close branch of submit() follows the same rule)
-        try:
-            r.future.set_exception(RuntimeError("engine closed"))
-        except InvalidStateError:
-            return  # already resolved (and released) elsewhere
-        self.telemetry.record_failure()
-        self._admission.release(r.model)
+        self._fail_request(r, RuntimeError("engine closed"))
 
     # -- lifecycle -------------------------------------------------------
     def close(self, timeout: float = 10.0) -> None:

@@ -78,6 +78,12 @@ class ServeTelemetry:
         self.batches = 0        # executed device batches
         self.rows = 0           # real rows across executed batches
         self.padded_rows = 0    # zero rows added to reach the bucket
+        # dispatcher supervision (engine._supervise): a crash fails the
+        # in-flight/queued futures and the loop restarts with backoff —
+        # these counters are how /stats distinguishes a self-healed
+        # engine from one that never faulted
+        self.dispatcher_crashes = 0
+        self.dispatcher_restarts = 0
 
     # -- recording (dispatcher + submit threads) -------------------------
     def record_submit(self) -> None:
@@ -95,6 +101,14 @@ class ServeTelemetry:
     def record_failure(self) -> None:
         with self._lock:
             self.failed += 1
+
+    def record_dispatcher_crash(self) -> None:
+        with self._lock:
+            self.dispatcher_crashes += 1
+
+    def record_dispatcher_restart(self) -> None:
+        with self._lock:
+            self.dispatcher_restarts += 1
 
     def record_batch(self, *, bucket: int, rows: int,
                      device_s: float) -> None:
@@ -126,6 +140,8 @@ class ServeTelemetry:
                 "batches": self.batches,
                 "rows": self.rows,
                 "padded_rows": self.padded_rows,
+                "dispatcher_crashes": self.dispatcher_crashes,
+                "dispatcher_restarts": self.dispatcher_restarts,
                 # fraction of executed device rows that were padding —
                 # high values mean the ladder is too coarse (or traffic
                 # too sparse) for the offered load

@@ -18,6 +18,8 @@ Also reproduces the reference's operational behaviors:
 from __future__ import annotations
 
 import json
+import os
+import shutil
 from pathlib import Path
 from typing import Any
 
@@ -25,10 +27,22 @@ import orbax.checkpoint as ocp
 
 from deepvision_tpu.train.loggers import Loggers
 
+MANIFEST_VERSION = 1
+
+
+def _hash_file(path: Path) -> str:
+    """Streaming SHA-256 — the repo's ONE implementation (incl. the
+    ``hashlib.file_digest`` fast path on 3.11+); lazy import keeps the
+    convert package off the checkpoint module's import path."""
+    from deepvision_tpu.convert.pretrained import file_digest
+
+    return file_digest(path, "sha256")
+
 
 class CheckpointManager:
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
-                 async_save: bool = False, keep_best_of: str | None = None):
+                 async_save: bool = False, keep_best_of: str | None = None,
+                 integrity: bool = True, fault_injector=None):
         """``async_save``: saves overlap with training — ``save()`` returns
         after staging the device arrays to host; serialization runs on a
         background thread (SURVEY §5.3's periodic async checkpointing; the
@@ -39,6 +53,19 @@ class CheckpointManager:
         value are kept instead of the most recent, the reference's
         save-on-new-best behavior with strictly better coverage
         (ref: YOLO/tensorflow/train.py:243-257 keeps best-val only).
+
+        ``integrity``: every committed save gets a JSON manifest beside
+        the step directory (``manifest-<epoch>.json``: per-file size +
+        SHA-256), written ATOMICALLY (tmp + ``os.replace``) so a SIGKILL
+        mid-write can never leave a truncated sidecar that poisons
+        resume. :meth:`restore_verified` recomputes the checksums,
+        quarantines corrupt epochs into ``quarantine/``, and falls back
+        to the newest verified older epoch instead of crashing — the
+        recovery contract of ``resilience/``.
+
+        ``fault_injector``: optional ``resilience.FaultInjector`` whose
+        ``ckpt_corrupt`` site is consulted after each committed save
+        (chaos tests corrupt a real on-disk file deterministically).
         """
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
@@ -56,6 +83,10 @@ class CheckpointManager:
             )
         self.keep_best_of = keep_best_of
         self._async = async_save
+        self._opts = opts
+        self.integrity = integrity
+        self._injector = fault_injector
+        self._pending_manifests: list[int] = []
         self._mgr = ocp.CheckpointManager(
             self.directory, options=ocp.CheckpointManagerOptions(**opts)
         )
@@ -70,6 +101,16 @@ class CheckpointManager:
             "best_metric": best_metric,
         }
         payload = self._payload(state)
+        if self._async and self._pending_manifests:
+            # the PRIOR epoch's async save: its manifest must hash
+            # COMMITTED files, so it was deferred — flush it now (Orbax
+            # admits one in-flight save at a time, so entering save(N+1)
+            # means save(N) is durable). Deferring to end-of-run instead
+            # would leave EVERY epoch manifest-less after a mid-run
+            # kill, and verify_epoch passes manifest-less epochs
+            # vacuously; this bounds the exposure to the newest epoch.
+            self._mgr.wait_until_finished()
+            self._flush_manifests()
         self._mgr.save(
             epoch,
             args=ocp.args.Composite(
@@ -78,13 +119,161 @@ class CheckpointManager:
             ),
             metrics=metrics,
         )
-        if not self._async:
+        if self._async:
+            self._pending_manifests.append(epoch)
+        else:
             self._mgr.wait_until_finished()
+            self._finalize_save(epoch)
 
     def wait_until_finished(self) -> None:
         """Block until any in-flight async save commits (restore-latest and
         process exit must not race a pending write)."""
         self._mgr.wait_until_finished()
+        self._flush_manifests()
+
+    def _flush_manifests(self) -> None:
+        while self._pending_manifests:
+            self._finalize_save(self._pending_manifests.pop(0))
+
+    # -- integrity (resilience/) ----------------------------------------
+    def _step_dir(self, epoch: int) -> Path:
+        return self.directory / str(epoch)
+
+    def _manifest_path(self, epoch: int) -> Path:
+        return self.directory / f"manifest-{epoch}.json"
+
+    def _finalize_save(self, epoch: int) -> None:
+        """Post-commit bookkeeping: write the integrity manifest for the
+        epoch, GC manifests whose step dir the retention policy already
+        deleted, and consult the fault injector (which corrupts AFTER
+        the manifest is written — exactly the bit-rot/truncation window
+        verification exists to catch)."""
+        if self.integrity:
+            self._write_manifest(epoch)
+            live = {p.name for p in self.directory.iterdir()
+                    if p.is_dir() and p.name.isdigit()}
+            for mp in self.directory.glob("manifest-*.json"):
+                if mp.stem.split("-", 1)[1] not in live:
+                    mp.unlink(missing_ok=True)
+        if self._injector is not None and self._step_dir(epoch).exists():
+            self._injector.corrupt_checkpoint(self._step_dir(epoch))
+
+    def _write_manifest(self, epoch: int) -> None:
+        step_dir = self._step_dir(epoch)
+        if not step_dir.exists():  # e.g. keep_best evicted it already
+            return
+        files = {
+            str(p.relative_to(step_dir)): {
+                "size": p.stat().st_size,
+                "sha256": _hash_file(p),
+            }
+            for p in sorted(step_dir.rglob("*")) if p.is_file()
+        }
+        manifest = {"version": MANIFEST_VERSION, "epoch": int(epoch),
+                    "files": files}
+        # atomic: a SIGKILL between write and replace leaves only the
+        # tmp file — never a truncated manifest that poisons resume
+        tmp = self._manifest_path(epoch).with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(manifest))
+        os.replace(tmp, self._manifest_path(epoch))
+
+    def verify_epoch(self, epoch: int) -> tuple[bool, str]:
+        """-> (ok, reason). An epoch with NO manifest verifies vacuously
+        (pre-integrity checkpoints stay restorable); an unreadable or
+        mismatching manifest fails it."""
+        step_dir = self._step_dir(epoch)
+        if not step_dir.exists():
+            return False, "step directory missing"
+        mp = self._manifest_path(epoch)
+        if not mp.exists():
+            return True, "no manifest (pre-integrity checkpoint)"
+        try:
+            manifest = json.loads(mp.read_text())
+            files = manifest["files"]
+            for rel, want in files.items():
+                p = step_dir / rel
+                if not p.is_file():
+                    return False, f"missing file {rel}"
+                if p.stat().st_size != want["size"]:
+                    return False, (f"size mismatch {rel}: "
+                                   f"{p.stat().st_size} != {want['size']}")
+                if _hash_file(p) != want["sha256"]:
+                    return False, f"checksum mismatch {rel}"
+        except (ValueError, KeyError, TypeError, AttributeError,
+                OSError) as e:
+            # parses-but-wrong-schema manifests and files vanishing
+            # mid-scan are corruption too — verification must FAIL
+            # them, never crash on them
+            return False, f"unreadable/malformed manifest: {e}"
+        return True, "ok"
+
+    def quarantine_epoch(self, epoch: int) -> Path:
+        """Move a corrupt epoch (and its manifest) into ``quarantine/``
+        for post-mortem instead of deleting evidence; reopens the
+        underlying Orbax manager, whose step cache would otherwise go
+        stale on the externally-moved directory."""
+        qroot = self.directory / "quarantine"
+        qroot.mkdir(exist_ok=True)
+        target = qroot / str(epoch)
+        n = 0
+        while target.exists():  # re-corrupted re-saves of the same epoch
+            n += 1
+            target = qroot / f"{epoch}.{n}"
+        shutil.move(str(self._step_dir(epoch)), str(target))
+        mp = self._manifest_path(epoch)
+        if mp.exists():
+            shutil.move(str(mp), str(target) + ".manifest.json")
+        self._reopen()
+        return target
+
+    def _reopen(self) -> None:
+        """Recreate the Orbax manager: its in-memory step list does not
+        track external directory moves (verified against orbax 0.7)."""
+        self._mgr.close()
+        self._mgr = ocp.CheckpointManager(
+            self.directory, options=ocp.CheckpointManagerOptions(
+                **self._opts)
+        )
+
+    def fs_epochs(self) -> list[int]:
+        """Epoch dirs actually on disk — the quarantine scan must not
+        trust the manager's (possibly stale) step cache."""
+        return sorted(int(p.name) for p in self.directory.iterdir()
+                      if p.is_dir() and p.name.isdigit())
+
+    def restore_verified(self, state, *, counters=None, log=print):
+        """Newest-first verified restore: checksum-verify each epoch,
+        quarantine failures (counting ``ckpt_fallbacks``), and return
+        the first epoch that both verifies and restores — the
+        crash-free ``resume()`` the recovery layer promises. Raises
+        ``FileNotFoundError`` only when no epoch survives.
+        """
+        self.wait_until_finished()
+        for epoch in reversed(self.fs_epochs()):
+            ok, why = self.verify_epoch(epoch)
+            if ok:
+                try:
+                    return self.restore(state, epoch)
+                except Exception as e:
+                    if self._manifest_path(epoch).exists():
+                        # checksums PROVED the files intact, yet restore
+                        # failed: that is a systematic error (template/
+                        # optimizer mismatch, sharding change), not
+                        # corruption — quarantining would repeat for
+                        # every older epoch and silently discard the
+                        # whole run's progress; surface it instead
+                        raise
+                    # manifest-less (pre-integrity) epoch: corruption is
+                    # plausible and undetectable — quarantine + fall back
+                    why = f"restore failed: {type(e).__name__}: {e}"
+            log(f"[ckpt-integrity] epoch {epoch}: {why}; quarantining "
+                "and falling back to an older epoch", flush=True)
+            self.quarantine_epoch(epoch)
+            if counters is not None:
+                counters.inc("ckpt_fallbacks")
+        raise FileNotFoundError(
+            f"no verifiable checkpoints left in {self.directory} "
+            "(corrupt epochs moved to quarantine/)")
 
     @staticmethod
     def _payload(state) -> dict:
@@ -199,4 +388,5 @@ class CheckpointManager:
         return state, self._decode_meta(restored["meta"])
 
     def close(self):
+        self.wait_until_finished()  # flush pending integrity manifests
         self._mgr.close()

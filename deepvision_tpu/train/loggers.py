@@ -39,6 +39,17 @@ def input_wait_metrics(summary: dict, prefix: str = "input_") -> dict:
     }
 
 
+def recovery_metrics(counters, prefix: str = "recovery_") -> dict:
+    """Flatten a ``resilience.RecoveryCounters`` (or a plain snapshot
+    dict) into loggable scalar metrics (``recovery_rollbacks`` …) —
+    the recovery analog of :func:`input_wait_metrics`: cumulative
+    counts logged per epoch, so the metric history says WHEN a run
+    rolled back / fell back / retried, not just that it did."""
+    snap = counters.snapshot() if hasattr(counters, "snapshot") \
+        else dict(counters)
+    return {prefix + k: float(v) for k, v in snap.items()}
+
+
 class Loggers:
     def __init__(self, metrics: list[str] | None = None):
         self.data: dict[str, dict[str, list]] = {}

@@ -31,13 +31,23 @@ import numpy as np
 
 from deepvision_tpu.core import shard_batch
 from deepvision_tpu.core.prng import KeySeq
-from deepvision_tpu.core.step import compile_eval_step, compile_train_step
+from deepvision_tpu.core.step import (
+    checkify_error_cls as _checkify_error,
+    compile_eval_step,
+    compile_train_step,
+)
 from deepvision_tpu.data.prefetch import DevicePrefetcher, FeedTelemetry
+from deepvision_tpu.resilience.recovery import (
+    NumericDivergence,
+    RecoveryCounters,
+    RecoveryError,
+)
 from deepvision_tpu.train.checkpoint import CheckpointManager
 from deepvision_tpu.train.loggers import (
     Loggers,
     TensorBoardWriter,
     input_wait_metrics,
+    recovery_metrics,
 )
 from deepvision_tpu.train.optimizers import make_optimizer, set_lr_scale
 from deepvision_tpu.train.state import create_train_state
@@ -138,6 +148,9 @@ class Trainer:
         stall_timeout: float | None = None,
         stall_abort: bool = False,
         rss_limit_gb: float | None = None,
+        recovery=None,
+        fault_injector=None,
+        ckpt_integrity: bool = True,
     ):
         self.model = model
         self.config = config
@@ -168,6 +181,26 @@ class Trainer:
             (1, size, size, config.get("channels", 3)), np.float32
         )
         self.state = create_train_state(model, self.tx, sample, rng=seed)
+        # self-healing (resilience/): with a RecoveryPolicy the checkify
+        # NaN/Inf tripwire becomes rollback-and-skip instead of a crash,
+        # transient data reads retry with backoff, and resume verifies
+        # checkpoint integrity with quarantine + fallback. The injector
+        # is the deterministic chaos harness those paths are tested with.
+        self.recovery = recovery
+        self.injector = fault_injector
+        self.rec_counters = RecoveryCounters()
+        self._consecutive_rollbacks = 0
+        if recovery is not None:
+            if not check_numerics:
+                # rollback needs the tripwire: without checkify the NaN
+                # silently corrupts the weights and nothing ever raises
+                print("[recovery] enabling --check-numerics (the NaN/Inf "
+                      "tripwire recovery rolls back from)", flush=True)
+                check_numerics = True
+            # rollback target of last resort (no checkpoint saved yet):
+            # a host-side copy of the pristine initial state. Costs one
+            # state-sized host buffer — the price of epoch-0 recovery.
+            self._init_state = jax.tree.map(np.asarray, self.state)
         state_spec = None
         if shard_weight_update:
             # ZeRO-1 analog: optimizer state + weight update sharded over
@@ -196,10 +229,16 @@ class Trainer:
         # async: per-epoch saves overlap the next epoch's compute;
         # keep_best: retention keyed on the plateau metric instead of
         # recency (ref: YOLO/tensorflow/train.py:243-257 best-val save)
+        # ckpt_integrity=False skips the per-save manifest hashing (one
+        # SHA-256 pass over the committed files) — the opt-out for
+        # multi-GB states where seconds per epoch matter more than a
+        # verified --recover resume later
         self.ckpt = CheckpointManager(
             self.workdir / "ckpt",
             async_save=async_checkpoint,
             keep_best_of="plateau_metric" if keep_best else None,
+            fault_injector=fault_injector,
+            integrity=ckpt_integrity,
         )
         self.start_epoch = 0
         self.start_step = 0  # mid-epoch resume point (preemption)
@@ -292,7 +331,11 @@ class Trainer:
             if delay:  # test hook: widen the locked critical section
                 time.sleep(delay)
             shutil.rmtree(target, ignore_errors=True)
-            mgr = CheckpointManager(target, max_to_keep=1)
+            # no integrity manifest here: the SIGTERM grace window is
+            # budgeted in seconds, and preemption saves are restored
+            # unverified (superseded at the next epoch save anyway)
+            mgr = CheckpointManager(target, max_to_keep=1,
+                                    integrity=False)
             try:
                 mgr.save(
                     epoch, self.state, loggers=self.loggers,
@@ -364,7 +407,25 @@ class Trainer:
                         "checkpoint is visible yet, and no epoch "
                         "checkpoint exists to fall back to — retry "
                         "once the in-flight save lands")
-        self.state, meta = self.ckpt.restore(self.state, epoch)
+        if self.recovery is not None and epoch is None:
+            # integrity-checked restore: a corrupt/truncated latest epoch
+            # is quarantined and the newest verified older epoch wins,
+            # instead of an Orbax decode crash killing the relaunch
+            self.state, meta = self.ckpt.restore_verified(
+                self.state, counters=self.rec_counters)
+        else:
+            if self.recovery is not None:
+                # operator-pinned epoch: verify it too, but NEVER
+                # silently substitute another epoch for an explicit pin
+                # — fail with the reason instead
+                ok, why = self.ckpt.verify_epoch(epoch)
+                if not ok:
+                    raise RuntimeError(
+                        f"--recover resume: pinned epoch {epoch} failed "
+                        f"integrity verification ({why}); pick another "
+                        "epoch, or drop the pin to fall back to the "
+                        "newest verified epoch automatically")
+            self.state, meta = self.ckpt.restore(self.state, epoch)
         self._reshard_state()
         self._apply_meta(meta)
         self.start_epoch = meta["epoch"] + 1
@@ -498,6 +559,15 @@ class Trainer:
             for j, batch in enumerate(self.train_data(epoch)):
                 if j < start_step:  # host-side skip keeps the data order
                     continue
+                if self.injector is not None:
+                    # chaos hooks (resilience/faults.py): consults land
+                    # AFTER the resume skip, so a rollback never replays
+                    # a consumed fault occurrence
+                    batch, fired = self.injector.poison_nan(batch)
+                    if fired:
+                        print(f"[fault] NaN-poisoned epoch {epoch} "
+                              f"batch {j}", flush=True)
+                    self.injector.maybe_stall()
                 counts.append(len(batch["image"]))
                 yield batch
 
@@ -509,13 +579,25 @@ class Trainer:
         # (preemption return, upstream exception), not just exhaustion.
         tel = FeedTelemetry()
         feed = DevicePrefetcher(counted(), self.mesh,
-                                depth=self.prefetch_depth, telemetry=tel)
+                                depth=self.prefetch_depth, telemetry=tel,
+                                fault_injector=self.injector,
+                                retry_policy=self.recovery,
+                                retry_counters=self.rec_counters)
         try:
             for i, device_batch in enumerate(feed):
                 for _ in range(self.data_echo):  # device-side batch reuse
-                    self.state, metrics = self._train_step(
-                        self.state, device_batch, next(keys)
-                    )
+                    try:
+                        self.state, metrics = self._train_step(
+                            self.state, device_batch, next(keys)
+                        )
+                    except _checkify_error() as e:
+                        if self.recovery is None:
+                            raise  # fail fast, exactly as before
+                        # the tripwire fired: hand the position to the
+                        # rollback loop in _fit (restore last-good
+                        # checkpoint, skip past this batch window)
+                        raise NumericDivergence(
+                            epoch, start_step + i, e) from e
                     pending.append(metrics)
                 # heartbeats land only in drain() (per COMPLETED step): a
                 # dispatch-side beat marks an ENQUEUED step, so a wedged
@@ -610,6 +692,63 @@ class Trainer:
         finally:
             if self._watchdog:
                 self._watchdog.stop()
+            # grep-stable summaries on EVERY exit path (the chaos gate
+            # asserts on these lines; operators read them post-mortem)
+            if self.injector is not None:
+                print(f"[faults] fired: {self.injector.summary()}",
+                      flush=True)
+            if self.recovery is not None:
+                print(f"[recovery] {self.rec_counters.format()}",
+                      flush=True)
+
+    def _rollback(self, nd: NumericDivergence) -> int:
+        """Recover from a tripped NaN/Inf check: restore the newest
+        VERIFIED checkpoint (quarantining corrupt ones — counted as
+        ``ckpt_fallbacks``), fall back to the pristine initial state if
+        none survives, optionally re-warm the LR, and return the step to
+        resume the epoch from (skipping the offending batch window; the
+        epoch-seeded data order + ``KeySeq.skip`` replay make the retry
+        deterministic). Aborts with :class:`RecoveryError` after
+        ``max_rollbacks`` consecutive rollbacks."""
+        pol = self.recovery
+        if self._consecutive_rollbacks >= pol.max_rollbacks:
+            # budget check BEFORE incrementing: the abort message and
+            # the [recovery] counter line must agree on how many
+            # rollbacks actually executed
+            raise RecoveryError(
+                f"aborting after {self._consecutive_rollbacks} "
+                f"consecutive rollbacks (max_rollbacks="
+                f"{pol.max_rollbacks}): the divergence is persistent, "
+                "not transient — inspect the data/LR before retrying"
+            ) from nd
+        self._consecutive_rollbacks += 1
+        self.rec_counters.inc("rollbacks")
+        try:
+            self.state, meta = self.ckpt.restore_verified(
+                self.state, counters=self.rec_counters)
+            source = f"epoch-{meta['epoch']} checkpoint"
+        except FileNotFoundError:
+            self.state = jax.device_put(self._init_state)
+            source = "initial state (no verifiable checkpoint yet)"
+        self._reshard_state()
+        if pol.lr_rewarm is not None and hasattr(
+                self.state.opt_state, "hyperparams"):
+            scale = float(
+                self.state.opt_state.hyperparams["lr_scale"]
+            ) * pol.lr_rewarm
+            self.state = self.state.replace(
+                opt_state=set_lr_scale(self.state.opt_state, scale))
+            if self.plateau is not None:
+                self.plateau.scale = scale  # keep controller consistent
+            self.rec_counters.inc("lr_rewarms")
+        resume_step = nd.step_in_epoch + pol.skip_batches
+        print(f"[rollback] NaN/Inf at epoch {nd.epoch} step "
+              f"{nd.step_in_epoch}: restored {source}; resuming epoch "
+              f"{nd.epoch} at step {resume_step} "
+              f"({self._consecutive_rollbacks}/{pol.max_rollbacks} "
+              "consecutive)", flush=True)
+        time.sleep(pol.backoff(self._consecutive_rollbacks - 1))
+        return resume_step
 
     def _fit(self, epochs: int | None = None) -> Loggers:
         total = epochs or self.config.get("total_epochs", 1)
@@ -621,9 +760,23 @@ class Trainer:
         for epoch in range(self.start_epoch, total):
             start_step = (self.start_step
                           if epoch == self.start_epoch else 0)
-            tr = self.train_epoch(epoch, start_step=start_step)
+            while True:
+                try:
+                    tr = self.train_epoch(epoch, start_step=start_step)
+                except NumericDivergence as nd:
+                    # tripwire -> rollback (resilience/): restore the
+                    # last-good state and retry the epoch past the
+                    # offending batch window; bounded by max_rollbacks
+                    start_step = self._rollback(nd)
+                    continue
+                break
+            self._consecutive_rollbacks = 0  # a completed epoch resets
             if tr is None:  # preempted mid-epoch; checkpoint already saved
                 return self.loggers
+            if self.recovery is not None:
+                # cumulative self-healing counters ride the metric
+                # history (and TB): the run must SAY what it survived
+                tr.update(recovery_metrics(self.rec_counters))
             if start_step:
                 # honest history: this epoch's train aggregates cover only
                 # the post-resume tail of the epoch

@@ -65,10 +65,17 @@ def build_engine(args):
     print(f"serving {[m.name for m in models]} buckets={buckets} "
           f"on {mesh.devices.size} device(s); compiling...",
           file=sys.stderr)
+    injector = None
+    if args.faults:
+        from deepvision_tpu.resilience import FaultInjector
+
+        injector = FaultInjector(args.faults, seed=args.fault_seed)
+        print(f"fault injection armed: {args.faults!r}", file=sys.stderr)
     engine = InferenceEngine(
         models, mesh=mesh, buckets=buckets, max_queue=args.max_queue,
         per_model_limit=args.per_model_limit,
         batch_window_s=args.batch_window_ms / 1e3,
+        fault_injector=injector,
     )
     print(f"warmup done in {engine.warmup_s}s "
           f"({engine.stats()['cache']['entries']} executables)",
@@ -183,6 +190,10 @@ def make_handler(engine, args):
 
     from deepvision_tpu.serve import ShedError
 
+    # static after build_engine: resolved once so the (load-balancer-
+    # hammered) /healthz probe never pays a full stats() snapshot
+    models = engine.stats()["models"]
+
     class Handler(http.server.BaseHTTPRequestHandler):
         # quiet per-request logging; telemetry is the observability
         def log_message(self, *a):
@@ -201,8 +212,12 @@ def make_handler(engine, args):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"status": "ok",
-                                 "models": engine.stats()["models"]})
+                # degraded (503) while the dispatcher supervisor sits in
+                # a post-crash backoff: load balancers should drain this
+                # replica, not route fresh traffic into the restart
+                h = engine.health()
+                h["models"] = models
+                self._send(200 if h["status"] == "ok" else 503, h)
             elif self.path == "/stats":
                 self._send(200, engine.stats())
             else:
@@ -280,6 +295,13 @@ def main(argv=None):
     p.add_argument("--num-classes", type=int, default=None)
     p.add_argument("--top", type=int, default=5)
     p.add_argument("--score", type=float, default=0.5)
+    p.add_argument("--faults", default=None,
+                   help="deterministic fault schedule for chaos drills "
+                        "(resilience/faults.py grammar, e.g. "
+                        "'crash@2' crashes the dispatcher on its 3rd "
+                        "batch — the supervisor must recover)")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for probabilistic (~) fault specs")
     args = p.parse_args(argv)
 
     engine = build_engine(args)

@@ -600,6 +600,93 @@ def test_load_config_reads_serve_funcs(tmp_path):
     assert "*dispatch*" in LintConfig().serve_funcs
 
 
+# ----------------------------------------------------------- JX111
+
+
+def test_jx111_flags_broad_except_around_step_call(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        class Harness:
+            def epoch(self, batches, key):
+                for b in batches:
+                    try:
+                        self.state, m = self._train_step(
+                            self.state, b, key)
+                    except Exception:
+                        continue          # swallows the NaN tripwire
+                try:
+                    m = my_eval_step(self.state, b)
+                except (ValueError, BaseException):
+                    m = None              # tuple containing a broad type
+                try:
+                    self.state, m = run_step_fn(self.state, b)
+                except:                   # noqa: E722 — bare except
+                    pass
+        """)
+    assert codes(r) == ["JX111", "JX111", "JX111"]
+    assert "checkify" in r.findings[0].message
+
+
+def test_jx111_passes_narrow_catch_reraise_and_non_step(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        from deepvision_tpu.core.step import checkify_error_cls
+
+        def epoch(state, batches, key, log):
+            for b in batches:
+                try:
+                    state, m = my_train_step(state, b, key)
+                except checkify_error_cls() as e:   # narrow: fine
+                    raise RuntimeError("diverged") from e
+            try:
+                state, m = my_train_step(state, b, key)
+            except Exception as e:
+                log(e)
+                raise                               # re-raised: safe
+            try:
+                state, m = my_train_step(state, b, key)
+            except Exception as e:
+                log(e)
+                raise e                             # same, named form
+            try:
+                x = load_batch(b)                   # not a step call
+            except Exception:
+                x = None
+            return state, x
+        """)
+    assert codes(r) == []
+
+
+def test_jx111_checked_step_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(checked_step_funcs=["run_model*"])
+    r = lint(tmp_path, "lib/loop.py", """
+        def epoch(state, b):
+            try:
+                y = run_model_fwd(state, b)         # matched by knob
+            except Exception:
+                y = None
+            try:
+                state, m = my_train_step(state, b)  # NOT matched now
+            except Exception:
+                m = None
+            return y, m
+        """, cfg=cfg)
+    assert codes(r) == ["JX111"]
+
+
+def test_load_config_reads_checked_step_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        checked_step_funcs = ["run_model*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.checked_step_funcs == ["run_model*"]
+    # defaults cover the repo's own step-call naming (Trainer's
+    # self._train_step, the steps.py *_train_step/*_eval_step contract)
+    assert "*_train_step" in LintConfig().checked_step_funcs
+
+
 # ------------------------------------------- suppression + baseline
 
 

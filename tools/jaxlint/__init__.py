@@ -22,6 +22,10 @@ Checker codes (tools/jaxlint/checkers.py):
     JX108  reshape/transpose in parallel/ without a sharding constraint
     JX109  blocking host sync (np.asarray/.block_until_ready()/
            jax.device_get) inside a loop consuming a prefetched iterator
+    JX110  jax.jit/pjit called inside a request-handling loop
+           (per-request retrace/compile on the serving path)
+    JX111  broad 'except Exception'/bare except around a compiled-step
+           call (swallows the checkify NaN/Inf tripwire)
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

@@ -824,3 +824,86 @@ class ServeRetraceChecker(Checker):
                                 "from a warmed shape-bucketed "
                                 "executable cache, serve/"
                                 "compile_cache.py)")
+
+
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+@register_checker
+class BroadExceptStepChecker(Checker):
+    """Broad ``except Exception`` / bare ``except`` around a
+    compiled-step call: the checkify NaN/Inf tripwire
+    (``core/step.compile_checked_train_step``) raises
+    ``JaxRuntimeError`` FROM the step call — a broad handler silently
+    swallows the one signal that distinguishes a numeric blow-up from a
+    loggable hiccup, and the run keeps training on corrupted weights.
+    Recovery code must catch ``core.step.checkify_error_cls()``
+    narrowly (the Trainer's rollback does) or re-raise. Which call
+    names count as compiled steps is the ``checked_step_funcs`` knob
+    (``jaxlint.toml``)."""
+
+    code = "JX111"
+    name = "broad-except-around-step"
+    description = ("broad 'except Exception'/bare except around a "
+                   "compiled-step call (swallows the checkify NaN/Inf "
+                   "tripwire)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.checked_step_funcs
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            step = self._step_call_in(node.body, patterns)
+            if step is None:
+                continue
+            for handler in node.handlers:
+                if not self._is_broad(handler.type):
+                    continue
+                if self._reraises(handler):
+                    continue  # inspect-and-rethrow is safe
+                yield mod.finding(
+                    handler, self.code,
+                    f"broad except around the compiled-step call "
+                    f"'{call_name(step)}' swallows the checkify "
+                    "NaN/Inf tripwire (JaxRuntimeError); catch "
+                    "core.step.checkify_error_cls() narrowly or "
+                    "re-raise")
+
+    @staticmethod
+    def _step_call_in(body, patterns) -> ast.Call | None:
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                la = last_attr(call_name(sub))
+                if la and any(fnmatch.fnmatch(la, p) for p in patterns):
+                    return sub
+        return None
+
+    @staticmethod
+    def _is_broad(exc_type: ast.AST | None) -> bool:
+        """Bare ``except``, ``except Exception``/``BaseException``, or a
+        tuple containing one of those."""
+        if exc_type is None:
+            return True
+        types = (exc_type.elts if isinstance(exc_type, ast.Tuple)
+                 else [exc_type])
+        for t in types:
+            name = last_attr(dotted_name(t))
+            if name in _BROAD_EXC_NAMES:
+                return True
+        return False
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        """Bare ``raise``, or ``raise e`` of the handler's own bound
+        name — both re-surface the caught exception unchanged."""
+        for sub in ast.walk(handler):
+            if not isinstance(sub, ast.Raise):
+                continue
+            if sub.exc is None:
+                return True
+            if handler.name and isinstance(sub.exc, ast.Name) \
+                    and sub.exc.id == handler.name:
+                return True
+        return False

@@ -248,6 +248,15 @@ class LintConfig:
     serve_funcs: list[str] = field(default_factory=lambda: [
         "*serve*", "*dispatch*", "*handle*", "*request_loop*",
     ])
+    # Call-name patterns treated as compiled-step invocations (JX111):
+    # a broad `except Exception`/bare `except` around one swallows the
+    # checkify NaN/Inf tripwire (core/step.compile_checked_train_step)
+    # along with real device failures — recovery code must catch
+    # `core.step.checkify_error_cls()` narrowly instead.
+    checked_step_funcs: list[str] = field(default_factory=lambda: [
+        "*_train_step", "*_eval_step", "*_step_fn", "train_step",
+        "eval_step",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -266,7 +275,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "traced_dirs", "data_dirs", "parallel_dirs",
         "traced_name_patterns", "jit_wrappers", "static_return_calls",
         "key_fresheners", "key_name_patterns", "constraint_funcs",
-        "prefetch_funcs", "serve_funcs", "disable",
+        "prefetch_funcs", "serve_funcs", "checked_step_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

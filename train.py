@@ -102,6 +102,37 @@ def parse_args():
                    help="one-sided label smoothing on the DCGAN "
                         "discriminator's real targets (Salimans et al. "
                         "2016); 0 = reference-parity plain BCE")
+    p.add_argument("--recover", action="store_true",
+                   help="self-healing mode (resilience/): the NaN/Inf "
+                        "tripwire rolls back to the last verified "
+                        "checkpoint and skips the offending batch "
+                        "window (implies --check-numerics), transient "
+                        "data reads retry with backoff, and resume "
+                        "quarantines corrupt checkpoints and falls "
+                        "back to the newest verified epoch")
+    p.add_argument("--max-rollbacks", type=int, default=3,
+                   help="consecutive NaN rollbacks before --recover "
+                        "aborts anyway (a persistent divergence must "
+                        "still fail loudly)")
+    p.add_argument("--lr-rewarm", type=float, default=None,
+                   help="multiply the optimizer lr_scale by this "
+                        "factor on every rollback (e.g. 0.5) — the "
+                        "classic post-blow-up re-warm; default: keep "
+                        "the LR")
+    p.add_argument("--faults", default=None,
+                   help="deterministic fault schedule for chaos drills "
+                        "(resilience/faults.py grammar, e.g. "
+                        "'nan@14,ckpt@1,io@8x2'); pair with --recover "
+                        "to test self-healing, omit it to verify the "
+                        "fail-fast paths")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for probabilistic (~) fault specs")
+    p.add_argument("--no-ckpt-integrity", action="store_true",
+                   help="skip the per-save checksum manifest (one "
+                        "SHA-256 pass over each committed checkpoint) "
+                        "— trades a verified --recover resume for "
+                        "save-time seconds on multi-GB states; "
+                        "manifest-less epochs restore unverified")
     p.add_argument("--data-echo", type=int, default=1,
                    help="optimizer steps per transferred batch (data "
                         "echoing, arXiv:1907.05550) — multiplies step "
@@ -168,7 +199,15 @@ def main():
     if args.prefetch_depth < 1:
         raise SystemExit(
             f"--prefetch-depth must be >= 1, got {args.prefetch_depth}")
+    if args.lr_rewarm is not None and not args.recover:
+        raise SystemExit("--lr-rewarm only applies with --recover "
+                         "(it scales the LR on each rollback)")
     if cfg["dataset"].startswith("gan"):
+        if args.recover or args.faults:
+            raise SystemExit(
+                "--recover/--faults ride the Trainer rollback loop; the "
+                "GAN fit_gan path has no checkpoint-rollback hook yet "
+                f"(this run: {args.model!r})")
         run_gan(args, cfg, dtype)
         return
     if cfg["dataset"] == "pose":
@@ -350,6 +389,19 @@ def main():
             for f in (train_data, val_data)
         )
 
+    recovery = None
+    if args.recover:
+        from deepvision_tpu.resilience import RecoveryPolicy
+
+        recovery = RecoveryPolicy(max_rollbacks=args.max_rollbacks,
+                                  lr_rewarm=args.lr_rewarm)
+    injector = None
+    if args.faults:
+        from deepvision_tpu.resilience import FaultInjector
+
+        injector = FaultInjector(args.faults, seed=args.fault_seed)
+        print(f"fault injection armed: {args.faults!r}", flush=True)
+
     mesh = create_mesh()
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     trainer = Trainer(
@@ -362,7 +414,9 @@ def main():
         prefetch_depth=args.prefetch_depth,
         stall_timeout=args.stall_timeout or None,
         stall_abort=args.stall_abort,
-        rss_limit_gb=args.rss_limit_gb or None, **step_fns,
+        rss_limit_gb=args.rss_limit_gb or None,
+        recovery=recovery, fault_injector=injector,
+        ckpt_integrity=not args.no_ckpt_integrity, **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
