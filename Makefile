@@ -64,6 +64,25 @@ serve-smoke:
 	    assert len(ok) == 3, rows; \
 	    print('serve-smoke OK (3/3 responses)')"
 
+# observability smoke: train 2 synthetic lenet epochs with span tracing
+# on, assert the exported Chrome trace carries the fetch/step/eval/
+# checkpoint spans and attributes >= 95% of epoch wall time to named
+# spans (tools/trace_summary.py), then GET /metrics from an in-process
+# server and assert Prometheus exposition-format parse + intact /stats
+# keys (tools/obs_smoke.py) — the `make check` observability gate
+obs-smoke:
+	@mkdir -p logs; L="logs/obs-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	rm -rf runs/obs-smoke; \
+	$(PY) train.py -m lenet5 --platform cpu --epochs 2 \
+		--synthetic-size 256 --batch-size 64 --steps-per-epoch 3 \
+		--trace runs/obs-smoke/trace.json \
+		--workdir runs/obs-smoke 2>&1 | tee "$$L" && \
+	$(PY) tools/trace_summary.py runs/obs-smoke/trace.json \
+		--assert-spans fetch,step,eval,checkpoint \
+		--min-coverage 0.95 2>&1 | tee -a "$$L" && \
+	$(PY) tools/obs_smoke.py 2>&1 | tee -a "$$L" && \
+	echo "obs-smoke OK (trace attribution + /metrics exposition)"
+
 # chaos smoke: a scripted fault schedule on the lenet synthetic config —
 # one NaN step (epoch-2 batch 2), one corrupt checkpoint (the epoch-1
 # save, i.e. the rollback's first restore candidate), and two transient
@@ -84,7 +103,7 @@ chaos-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke chaos-smoke
+check: lint serve-smoke obs-smoke chaos-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -208,4 +227,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint check serve-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint check serve-smoke obs-smoke bench dryrun tensorboard find-python list-models rehearsal

@@ -234,8 +234,21 @@ def main() -> None:
         **({"remat": remat} if remat else {}),
         **({"zoo": zoo} if zoo else {}),
         **fed,
+        "obs": _obs_snapshot(),
     }
     print(json.dumps(out))
+
+
+def _obs_snapshot() -> dict:
+    """The merged obs registry view embedded in the bench record: the
+    input_* feed histograms the fed reps just exercised (per-batch
+    stage quantiles, not only the means in *_input_wait) + mem_* device
+    gauges sampled here (empty on CPU — driver runs report real HBM)."""
+    from deepvision_tpu.obs.metrics import default_registry
+    from deepvision_tpu.obs.profiler import sample_memory_gauges
+
+    sample_memory_gauges()
+    return default_registry().snapshot()
 
 
 # ---- per-family zoo sweep (VERDICT r4 #5) -------------------------------
@@ -671,6 +684,7 @@ def serve_bench(n_requests: int = SERVE_REQUESTS) -> dict:
             "no_retrace_after_warmup": (
                 stats["cache"]["misses"] == misses_warm),
             "device_kind": jax.devices()[0].device_kind,
+            "obs": _obs_snapshot(),
         }
     finally:
         engine.close()
@@ -679,7 +693,22 @@ def serve_bench(n_requests: int = SERVE_REQUESTS) -> dict:
 if __name__ == "__main__":
     import sys
 
-    if "serve" in sys.argv[1:]:
-        print(json.dumps(serve_bench()))
-    else:
-        main()
+    # BENCH_TRACE=path: span-trace the bench itself (the feed loops
+    # carry fetch/host_next/shard spans) and export Chrome trace JSON
+    _trace_path = os.environ.get("BENCH_TRACE")
+    if _trace_path:
+        from deepvision_tpu.obs.trace import get_tracer
+
+        get_tracer().enable()
+    try:
+        if "serve" in sys.argv[1:]:
+            print(json.dumps(serve_bench()))
+        else:
+            main()
+    finally:
+        # export on EVERY exit (same contract as train.py --trace): a
+        # crashed bench's partial trace is the one worth reading
+        if _trace_path:
+            _n = get_tracer().export(_trace_path)
+            print(f"# wrote {_n} spans to {_trace_path}",
+                  file=sys.stderr)

@@ -41,7 +41,18 @@ import threading
 import time
 from typing import Callable, Iterable, Iterator
 
+from deepvision_tpu.obs.metrics import (
+    Counter,
+    Histogram,
+    Registry,
+    default_registry,
+)
+from deepvision_tpu.obs.trace import span
+
 __all__ = ["DevicePrefetcher", "FeedTelemetry", "device_prefetch"]
+
+# pipeline stages, in snapshot()/summary() field order
+_STAGES = ("host_wait", "shard", "h2d_wait", "step")
 
 
 class FeedTelemetry:
@@ -51,10 +62,25 @@ class FeedTelemetry:
     milliseconds plus ``input_wait_frac`` — the fraction of consumer
     wall time spent waiting on input rather than stepping (the number
     that says "link-bound" vs "compute-bound" at a glance).
+
+    Each stage accumulator is an :class:`obs.metrics.Histogram` (one
+    sample per accumulation, so the registry also serves per-batch
+    stage quantiles) registered into ``registry`` under
+    ``<namespace>_<stage>`` names (``input_host_wait`` …) — the same
+    ``input_`` namespace ``train/loggers.input_wait_metrics`` has
+    always used for the logged per-epoch means. The legacy attribute
+    surface (``tel.h2d_wait_s += dt``, plain assignment included) is
+    kept via properties over the histogram totals, and
+    ``snapshot()``/``summary()`` are byte-compatible with the pre-obs
+    shapes.
     """
 
-    def __init__(self):
-        self.reset()
+    def __init__(self, registry: Registry | None = None,
+                 namespace: str = "input"):
+        reg = registry if registry is not None else default_registry()
+        self._h = {s: reg.register(f"{namespace}_{s}", Histogram())
+                   for s in _STAGES}
+        self._batches = reg.register(f"{namespace}_batches", Counter())
 
     def reset(self) -> None:
         """Zero all counters. NOTE: while a producer thread is live this
@@ -63,11 +89,37 @@ class FeedTelemetry:
         summary to the steady state of a running feed, take a
         :meth:`snapshot` and pass it to ``summary(since=...)`` instead
         (reads only, race-free)."""
-        self.host_wait_s = 0.0  # producer blocked on the upstream iterator
-        self.shard_s = 0.0      # host staging + async device_put dispatch
-        self.h2d_wait_s = 0.0   # consumer blocked on a ready device batch
-        self.step_s = 0.0       # consumer time between batches (the step)
-        self.batches = 0
+        for h in self._h.values():
+            h.reset()
+        self._batches.reset()
+
+    # legacy accumulator surface: `tel.host_wait_s += dt` (the producer
+    # and consumer hot paths) and plain assignment both route through
+    # these properties — a += lands as ONE histogram sample of dt
+    def _get_stage(self, stage: str) -> float:
+        return self._h[stage].total
+
+    def _set_stage(self, stage: str, value: float) -> None:
+        h = self._h[stage]
+        delta = value - h.total
+        if delta < 0:  # direct rewind (reset-style assignment)
+            h.reset()
+            delta = value
+        if delta:
+            h.observe(delta)
+
+    @property
+    def batches(self) -> int:
+        return self._batches.value
+
+    @batches.setter
+    def batches(self, value: int) -> None:
+        delta = int(value) - self._batches.value
+        if delta < 0:
+            self._batches.reset()
+            delta = int(value)
+        if delta:
+            self._batches.inc(delta)
 
     _FIELDS = ("host_wait_s", "shard_s", "h2d_wait_s", "step_s",
                "batches")
@@ -104,6 +156,18 @@ class FeedTelemetry:
                 round(wait / (wait + busy), 4) if wait + busy > 0 else 0.0
             ),
         }
+
+
+# the four stage accumulators as attribute properties:
+#   host_wait_s — producer blocked on the upstream iterator
+#   shard_s     — host staging + async device_put dispatch
+#   h2d_wait_s  — consumer blocked on a ready device batch
+#   step_s      — consumer time between batches (the step)
+for _stage in _STAGES:
+    setattr(FeedTelemetry, f"{_stage}_s", property(
+        lambda self, _s=_stage: self._get_stage(_s),
+        lambda self, v, _s=_stage: self._set_stage(_s, v)))
+del _stage
 
 
 # queue item kinds (first tuple element)
@@ -160,13 +224,15 @@ class DevicePrefetcher:
             while not self._stop.is_set():
                 t0 = time.perf_counter()
                 try:
-                    batch = self._next_batch()
+                    with span("host_next", cat="feed"):
+                        batch = self._next_batch()
                 except StopIteration:
                     self._put((_DONE, None))
                     return
                 t1 = time.perf_counter()
                 tel.host_wait_s += t1 - t0
-                device_batch = self._shard(batch)  # async H2D in flight
+                with span("shard", cat="feed"):
+                    device_batch = self._shard(batch)  # async H2D in flight
                 tel.shard_s += time.perf_counter() - t1
                 if not self._put((_BATCH, device_batch)):
                     return  # closed while we waited for queue space
@@ -246,7 +312,8 @@ class DevicePrefetcher:
         t0 = time.perf_counter()
         if self._last_yield is not None:
             self.telemetry.step_s += t0 - self._last_yield
-        kind, payload = self._q.get()
+        with span("fetch", cat="feed"):  # consumer blocked on the queue
+            kind, payload = self._q.get()
         self.telemetry.h2d_wait_s += time.perf_counter() - t0
         if kind is _DONE:
             self._finished = True

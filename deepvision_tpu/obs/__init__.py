@@ -1,0 +1,67 @@
+"""deepvision_tpu.obs — unified observability for training and serving.
+
+One subsystem replacing four ad-hoc telemetry implementations
+(``train/loggers``, ``data/prefetch.FeedTelemetry``,
+``serve/telemetry.ServeTelemetry``, ``resilience.RecoveryCounters``
+each had its own locks, deques, naming, and export path):
+
+- ``metrics``  : process-wide thread-safe registry — counters, gauges,
+                 bounded-reservoir histograms (p50/p95/p99) — with a
+                 stable ``namespace_name`` scheme, one merged JSON
+                 ``snapshot()``, and Prometheus text exposition for
+                 ``serve.py GET /metrics``.
+- ``trace``    : lightweight span tracing (``with span("h2d")``),
+                 thread-aware, monotonic-clock, explicit
+                 ``device_sync=`` to measure compute instead of async
+                 dispatch; ring buffer + Chrome-trace-format export
+                 (chrome://tracing / Perfetto) + ``summarize_chrome``
+                 (CLI: ``tools/trace_summary.py``).
+- ``profiler`` : opt-in ``jax.profiler`` windows (``train.py
+                 --profile-steps A:B``, ``serve.py --profile-dir``) and
+                 ``mem_*`` device-memory gauges from
+                 ``memory_stats()`` (graceful no-op on CPU).
+
+The four telemetry objects now register their metrics here at
+construction, so train-feed, serve-latency, recovery, and memory
+metrics all render from the SAME registry — while every pre-existing
+metric name, ``/stats`` JSON key, and grep-stable log line stays
+byte-compatible.
+"""
+
+from deepvision_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+)
+from deepvision_tpu.obs.profiler import (
+    ProfileWindow,
+    device_memory_stats,
+    profile_session,
+    sample_memory_gauges,
+)
+from deepvision_tpu.obs.trace import (
+    Span,
+    Tracer,
+    get_tracer,
+    span,
+    summarize_chrome,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "default_registry",
+    "ProfileWindow",
+    "device_memory_stats",
+    "profile_session",
+    "sample_memory_gauges",
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "span",
+    "summarize_chrome",
+]

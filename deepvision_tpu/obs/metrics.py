@@ -1,0 +1,305 @@
+"""Process-wide metric registry: counters, gauges, reservoir histograms.
+
+Before this module, four telemetry objects each reinvented the same
+primitives — ``serve/telemetry.LatencyStats`` (lock + deque + numpy
+percentiles), ``data/prefetch.FeedTelemetry`` (bare float accumulators,
+explicitly documented as racing their own ``reset``),
+``resilience.RecoveryCounters`` (lock + dict of ints), and the
+``train/loggers`` metric history — with four naming schemes and four
+export paths, none of which could be read as ONE view of the process.
+
+Here the primitives live once:
+
+- :class:`Counter` / :class:`Gauge` — lock-guarded scalars;
+- :class:`Histogram` — bounded-reservoir series (most recent ``maxlen``
+  samples for p50/p95/p99) with EXACT lifetime ``count``/``total``.
+  Every read of the (count, total, samples) triple happens under the
+  histogram's own lock, so a reader can never see a torn count/total
+  pair no matter which thread it runs on — the serve ``/stats`` path
+  previously only got that guarantee when callers remembered to hold
+  the outer telemetry lock;
+- :class:`Registry` — a thread-safe name->metric table with a stable
+  ``namespace_name`` naming scheme (``serve_e2e_latency``,
+  ``input_h2d_wait``, ``recovery_rollbacks``, ``mem_bytes_in_use_dev0``),
+  one merged JSON :meth:`~Registry.snapshot`, and a Prometheus text
+  exposition renderer (:meth:`~Registry.render_prometheus`) for the
+  ``serve.py GET /metrics`` surface.
+
+The process-wide default registry (:func:`default_registry`) is what the
+existing telemetry objects register into at construction; re-registering
+a name replaces the previous owner (latest wins — telemetry objects are
+long-lived per-process singletons in production, and tests that build
+many engines sequentially must not accrete stale series).
+
+Units: histograms record SECONDS. The JSON snapshot reports derived
+milliseconds (``*_ms`` keys, matching the pre-existing ``/stats`` and
+``input_*`` shapes); the Prometheus rendering reports base-unit seconds
+(quantile samples + ``_sum``), per Prometheus convention.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import deque
+
+import numpy as np
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "default_registry",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class Counter:
+    """Monotonic (in normal use) integer counter; ``inc`` from any
+    thread, ``value`` reads are consistent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def __repr__(self) -> str:
+        return f"Counter({self.value})"
+
+
+class Gauge:
+    """Last-written float value (memory in use, queue depth, ...)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._value += delta
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def __repr__(self) -> str:
+        return f"Gauge({self.value})"
+
+
+class Histogram:
+    """Bounded-reservoir time series with percentile snapshots.
+
+    ``observe`` takes seconds; :meth:`summary` reports milliseconds in
+    the exact shape ``serve/telemetry.LatencyStats.summary`` has always
+    produced (``/stats`` JSON contract). The reservoir keeps the most
+    recent ``maxlen`` samples (enough for stable p99 at serving rates)
+    while ``count``/``total`` stay exact over the metric's lifetime.
+
+    All three of (samples, count, total) mutate and read under ONE
+    internal lock: ``summary()`` computes ``mean_ms`` from a coherent
+    (count, total) pair even while writers are mid-``observe``.
+    """
+
+    def __init__(self, maxlen: int = 8192):
+        self._lock = threading.Lock()
+        self._samples: deque[float] = deque(maxlen=maxlen)
+        self._count = 0
+        self._total = 0.0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(value)
+            self._count += 1
+            self._total += value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()
+            self._count = 0
+            self._total = 0.0
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return self._total
+
+    def export(self, qs=(0.5, 0.95, 0.99)) -> dict:
+        """Base-unit (seconds) view for the Prometheus rendering: one
+        locked read yields a coherent (count, sum, quantiles) triple."""
+        with self._lock:
+            samples = list(self._samples)
+            count, total = self._count, self._total
+        if samples:
+            arr = np.asarray(samples, np.float64)
+            vals = np.percentile(arr, [q * 100.0 for q in qs])
+            quant = {q: float(v) for q, v in zip(qs, vals)}
+        else:
+            quant = {q: 0.0 for q in qs}
+        return {"count": count, "sum": total, "quantiles": quant}
+
+    def summary(self) -> dict:
+        with self._lock:
+            samples = list(self._samples)
+            count, total = self._count, self._total
+        if not samples:
+            return {"count": count, "mean_ms": 0.0, "p50_ms": 0.0,
+                    "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+        arr = np.asarray(samples, np.float64) * 1e3
+        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+        return {
+            "count": count,
+            "mean_ms": round(total / max(1, count) * 1e3, 3),
+            "p50_ms": round(float(p50), 3),
+            "p95_ms": round(float(p95), 3),
+            "p99_ms": round(float(p99), 3),
+            "max_ms": round(float(arr.max()), 3),
+        }
+
+    def __repr__(self) -> str:
+        return f"Histogram(count={self.count})"
+
+
+_METRIC_TYPES = (Counter, Gauge, Histogram)
+
+
+class Registry:
+    """Thread-safe name -> metric table with one merged snapshot.
+
+    Names follow ``namespace_name`` (``serve_completed``,
+    ``input_h2d_wait``); :meth:`register` replaces an existing owner
+    (latest wins), the get-or-create helpers (:meth:`counter`,
+    :meth:`gauge`, :meth:`histogram`) return the existing metric — and
+    refuse a type change, which is always a naming-collision bug.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+
+    # -- registration ----------------------------------------------------
+    def register(self, name: str, metric):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r} (want "
+                             "[a-zA-Z_][a-zA-Z0-9_]*)")
+        if not isinstance(metric, _METRIC_TYPES):
+            raise TypeError(f"not a metric: {metric!r}")
+        with self._lock:
+            self._metrics[name] = metric
+        return metric
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def _get_or_create(self, name: str, cls, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(m).__name__}, not {cls.__name__}")
+                return m
+        # create outside the lock, register() re-takes it (a racing
+        # duplicate create is harmless: last registration wins)
+        return self.register(name, factory())
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge, Gauge)
+
+    def histogram(self, name: str, maxlen: int = 8192) -> Histogram:
+        return self._get_or_create(name, Histogram,
+                                   lambda: Histogram(maxlen=maxlen))
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    # -- export ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """One merged JSON-able view: counters -> int, gauges -> float,
+        histograms -> their ``summary()`` dict (ms)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out: dict = {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = m.value
+            elif isinstance(m, Gauge):
+                out[name] = m.value
+            else:
+                out[name] = m.summary()
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (format version 0.0.4): counters
+        as ``<name>_total``, gauges verbatim, histograms as summaries
+        (p50/p95/p99 quantile samples in seconds + ``_sum``/``_count``).
+        """
+        with self._lock:
+            items = sorted(self._metrics.items())
+        lines: list[str] = []
+        for name, m in items:
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name}_total counter")
+                lines.append(f"{name}_total {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt(m.value)}")
+            else:
+                ex = m.export()  # coherent (count, sum, quantiles)
+                lines.append(f"# TYPE {name} summary")
+                for q, v in ex["quantiles"].items():
+                    lines.append(f'{name}{{quantile="{q:g}"}} {_fmt(v)}')
+                lines.append(f"{name}_sum {_fmt(ex['sum'])}")
+                lines.append(f"{name}_count {ex['count']}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.9g}"
+
+
+_DEFAULT = Registry()
+
+
+def default_registry() -> Registry:
+    """The process-wide registry every telemetry object registers into
+    by default — the single source for ``GET /metrics`` and the bench
+    JSON's ``obs`` block."""
+    return _DEFAULT

@@ -18,8 +18,9 @@ survived, or operators can't tell self-healing from silence.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
+
+from deepvision_tpu.obs.metrics import Counter, Registry, default_registry
 
 __all__ = [
     "NumericDivergence",
@@ -48,27 +49,31 @@ class NumericDivergence(RuntimeError):
 
 class RecoveryCounters:
     """Thread-safe recovery event counters (producer thread + step loop
-    + checkpoint scan all increment)."""
+    + checkpoint scan all increment).
+
+    Each field is an :class:`obs.metrics.Counter` registered into
+    ``registry`` (default: the process registry) under ``recovery_*``
+    names — the SAME names ``train/loggers.recovery_metrics`` logs per
+    epoch — so the merged obs snapshot and ``GET /metrics`` carry the
+    recovery audit trail without a second bookkeeping path."""
 
     FIELDS = ("rollbacks", "ckpt_fallbacks", "data_retries", "lr_rewarms")
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = {k: 0 for k in self.FIELDS}
+    def __init__(self, registry: Registry | None = None):
+        reg = registry if registry is not None else default_registry()
+        self._counts = {k: reg.register(f"recovery_{k}", Counter())
+                        for k in self.FIELDS}
 
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[name] += n
+        self._counts[name].inc(n)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts[name]
+        return self._counts[name].value
 
     def snapshot(self) -> dict:
         """Plain-dict view; ``train/loggers.recovery_metrics`` flattens
         it into the per-epoch ``recovery_*`` metric surface."""
-        with self._lock:
-            return dict(self._counts)
+        return {k: c.value for k, c in self._counts.items()}
 
     def format(self) -> str:
         """Grep-stable one-liner (``make chaos-smoke`` asserts on it)."""

@@ -9,118 +9,145 @@ time (the compiled forward), and end-to-end latency — and keeps the
 admission/outcome counters (completed / timed out / shed) that say at a
 glance whether the engine is keeping up with offered load.
 
-Latencies are recorded into bounded reservoirs (a deque of the most
-recent samples) so ``snapshot()`` can report p50/p95/p99 without
-unbounded memory on a long-lived server; totals/counts are exact over
-the process lifetime. All mutation is lock-guarded: ``submit()`` runs on
-caller threads, the dispatcher records on its own thread, and ``/stats``
-readers snapshot from HTTP handler threads.
+Since the ``obs`` subsystem exists, the primitives live there: every
+latency series is an :class:`obs.metrics.Histogram` (bounded reservoir,
+exact lifetime count/total, p50/p95/p99 snapshots) and every counter an
+:class:`obs.metrics.Counter`, all registered into the process registry
+under ``serve_*`` names — so ``GET /metrics`` (Prometheus) and the one
+merged ``obs`` snapshot render the same numbers ``/stats`` reports.
+The ``/stats`` JSON shape is byte-compatible with the pre-obs
+implementation, and torn reads are structurally impossible now: a
+histogram's (count, total, samples) triple is read under its own lock
+inside ``summary()``, so even a snapshot taken outside ``_lock`` (the
+old ``/stats`` hazard) can never see a count/total pair mid-record.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import deque
+
+from deepvision_tpu.obs.metrics import (
+    Counter,
+    Histogram,
+    Registry,
+    default_registry,
+)
 
 __all__ = ["LatencyStats", "ServeTelemetry"]
 
 
 class LatencyStats:
-    """Bounded-reservoir latency series with percentile snapshots.
+    """Bounded-reservoir latency series with percentile snapshots —
+    now a thin wrapper over :class:`obs.metrics.Histogram` (the summary
+    dict is byte-compatible with the pre-obs shape).
 
     ``record`` takes seconds; ``summary`` reports milliseconds. The
     reservoir keeps the most recent ``maxlen`` samples (enough for
     stable p99 at serving rates) while ``count``/``total_s`` stay exact.
     """
 
-    def __init__(self, maxlen: int = 8192):
-        self._samples: deque[float] = deque(maxlen=maxlen)
-        self.count = 0
-        self.total_s = 0.0
+    def __init__(self, maxlen: int = 8192,
+                 hist: Histogram | None = None):
+        self._hist = hist if hist is not None else Histogram(maxlen=maxlen)
+
+    @property
+    def hist(self) -> Histogram:
+        return self._hist
 
     def record(self, seconds: float) -> None:
-        self._samples.append(seconds)
-        self.count += 1
-        self.total_s += seconds
+        self._hist.observe(seconds)
+
+    @property
+    def count(self) -> int:
+        return self._hist.count
+
+    @property
+    def total_s(self) -> float:
+        return self._hist.total
 
     def summary(self) -> dict:
-        import numpy as np
+        return self._hist.summary()
 
-        if not self._samples:
-            return {"count": self.count, "mean_ms": 0.0, "p50_ms": 0.0,
-                    "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
-        arr = np.asarray(self._samples, dtype=np.float64) * 1e3
-        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
-        return {
-            "count": self.count,
-            "mean_ms": round(self.total_s / max(1, self.count) * 1e3, 3),
-            "p50_ms": round(float(p50), 3),
-            "p95_ms": round(float(p95), 3),
-            "p99_ms": round(float(p99), 3),
-            "max_ms": round(float(arr.max()), 3),
-        }
+
+# exact counters, in the /stats JSON order (dict order is the contract)
+_COUNTER_FIELDS = (
+    "submitted",      # admitted into the queue
+    "completed",      # futures resolved with a result
+    "timed_out",      # deadline expired while queued
+    "failed",         # postprocess/forward raised
+    "shed",           # rejected at admission (backpressure)
+    "batches",        # executed device batches
+    "rows",           # real rows across executed batches
+    "padded_rows",    # zero rows added to reach the bucket
+    # dispatcher supervision (engine._supervise): a crash fails the
+    # in-flight/queued futures and the loop restarts with backoff —
+    # these counters are how /stats distinguishes a self-healed
+    # engine from one that never faulted
+    "dispatcher_crashes",
+    "dispatcher_restarts",
+)
 
 
 class ServeTelemetry:
-    """Counters + per-stage histograms for one engine's lifetime."""
+    """Counters + per-stage histograms for one engine's lifetime.
 
-    def __init__(self):
+    Registers everything into ``registry`` (default: the process
+    registry) under ``serve_*`` names; a newer engine's telemetry
+    replaces an older one's registrations (latest wins), so the
+    Prometheus surface always reflects the live engine. ``_lock``
+    still brackets multi-field records (e.g. ``record_batch`` touching
+    batches+rows+padded_rows+device_time) so ``snapshot()`` reports
+    coherent cross-counter derived values like ``pad_overhead_frac``.
+    """
+
+    def __init__(self, registry: Registry | None = None):
+        reg = registry if registry is not None else default_registry()
         self._lock = threading.Lock()
-        self.queue_wait = LatencyStats()   # admitted -> batch dispatch
-        self.device_time = LatencyStats()  # compiled forward, per batch
-        self.e2e = LatencyStats()          # admitted -> future resolved
-        # exact counters
-        self.submitted = 0      # admitted into the queue
-        self.completed = 0      # futures resolved with a result
-        self.timed_out = 0      # deadline expired while queued
-        self.failed = 0         # postprocess/forward raised
-        self.shed = 0           # rejected at admission (backpressure)
-        self.batches = 0        # executed device batches
-        self.rows = 0           # real rows across executed batches
-        self.padded_rows = 0    # zero rows added to reach the bucket
-        # dispatcher supervision (engine._supervise): a crash fails the
-        # in-flight/queued futures and the loop restarts with backoff —
-        # these counters are how /stats distinguishes a self-healed
-        # engine from one that never faulted
-        self.dispatcher_crashes = 0
-        self.dispatcher_restarts = 0
+        self._c = {f: reg.register(f"serve_{f}", Counter())
+                   for f in _COUNTER_FIELDS}
+        self.queue_wait = LatencyStats(   # admitted -> batch dispatch
+            hist=reg.register("serve_queue_wait", Histogram()))
+        self.device_time = LatencyStats(  # compiled forward, per batch
+            hist=reg.register("serve_device_time", Histogram()))
+        self.e2e = LatencyStats(          # admitted -> future resolved
+            hist=reg.register("serve_e2e_latency", Histogram()))
 
     # -- recording (dispatcher + submit threads) -------------------------
     def record_submit(self) -> None:
         with self._lock:
-            self.submitted += 1
+            self._c["submitted"].inc()
 
     def record_shed(self) -> None:
         with self._lock:
-            self.shed += 1
+            self._c["shed"].inc()
 
     def record_timeout(self) -> None:
         with self._lock:
-            self.timed_out += 1
+            self._c["timed_out"].inc()
 
     def record_failure(self) -> None:
         with self._lock:
-            self.failed += 1
+            self._c["failed"].inc()
 
     def record_dispatcher_crash(self) -> None:
         with self._lock:
-            self.dispatcher_crashes += 1
+            self._c["dispatcher_crashes"].inc()
 
     def record_dispatcher_restart(self) -> None:
         with self._lock:
-            self.dispatcher_restarts += 1
+            self._c["dispatcher_restarts"].inc()
 
     def record_batch(self, *, bucket: int, rows: int,
                      device_s: float) -> None:
         with self._lock:
-            self.batches += 1
-            self.rows += rows
-            self.padded_rows += bucket - rows
+            self._c["batches"].inc()
+            self._c["rows"].inc(rows)
+            self._c["padded_rows"].inc(bucket - rows)
             self.device_time.record(device_s)
 
     def record_request(self, *, queue_wait_s: float, e2e_s: float) -> None:
         with self._lock:
-            self.completed += 1
+            self._c["completed"].inc()
             self.queue_wait.record(queue_wait_s)
             self.e2e.record(e2e_s)
 
@@ -128,30 +155,32 @@ class ServeTelemetry:
     def snapshot(self) -> dict:
         """One JSON-able dict: counters, pad overhead, and p50/p95/p99
         blocks per stage (the serving analog of
-        ``FeedTelemetry.summary``)."""
+        ``FeedTelemetry.summary``) — key-for-key identical to the
+        pre-obs shape (the ``/stats`` contract)."""
         with self._lock:
-            executed = self.rows + self.padded_rows
+            vals = {f: c.value for f, c in self._c.items()}
+            executed = vals["rows"] + vals["padded_rows"]
             return {
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "timed_out": self.timed_out,
-                "failed": self.failed,
-                "shed": self.shed,
-                "batches": self.batches,
-                "rows": self.rows,
-                "padded_rows": self.padded_rows,
-                "dispatcher_crashes": self.dispatcher_crashes,
-                "dispatcher_restarts": self.dispatcher_restarts,
+                **vals,
                 # fraction of executed device rows that were padding —
                 # high values mean the ladder is too coarse (or traffic
                 # too sparse) for the offered load
                 "pad_overhead_frac": (
-                    round(self.padded_rows / executed, 4) if executed
+                    round(vals["padded_rows"] / executed, 4) if executed
                     else 0.0),
                 "mean_batch_rows": (
-                    round(self.rows / self.batches, 2) if self.batches
-                    else 0.0),
+                    round(vals["rows"] / vals["batches"], 2)
+                    if vals["batches"] else 0.0),
                 "queue_wait": self.queue_wait.summary(),
                 "device_time": self.device_time.summary(),
                 "e2e_latency": self.e2e.summary(),
             }
+
+
+# attribute-style counter reads (eng.telemetry.batches, .timed_out, ...)
+# are part of the public surface — generate one read-only property per
+# counter field instead of ten hand-rolled copies
+for _f in _COUNTER_FIELDS:
+    setattr(ServeTelemetry, _f,
+            property(lambda self, _f=_f: self._c[_f].value))
+del _f

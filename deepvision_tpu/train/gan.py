@@ -509,6 +509,7 @@ def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
                     preempt, watchdog, prefetch_depth=2):
     from deepvision_tpu.core.prng import KeySeq
     from deepvision_tpu.data.prefetch import DevicePrefetcher, FeedTelemetry
+    from deepvision_tpu.obs.trace import span
     from deepvision_tpu.train.loggers import input_wait_metrics
 
     for epoch in range(start_epoch, epochs):
@@ -527,38 +528,47 @@ def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
 
         def drain():
             # completed-step heartbeats, same rationale as Trainer
-            for m in pending:
-                fetched.append({k: float(v) for k, v in m.items()})
-                if watchdog is not None:
-                    watchdog.beat()
-            pending.clear()
+            if not pending:
+                return
+            with span("drain", cat="train"):
+                for m in pending:
+                    fetched.append({k: float(v) for k, v in m.items()})
+                    if watchdog is not None:
+                        watchdog.beat()
+                pending.clear()
 
         # async H2D feed (data/prefetch.py, same as Trainer.train_epoch):
         # producer-thread sharding keeps `prefetch_depth` transfers in
-        # flight; close() in the finally stops the thread on every exit
+        # flight; close() in the finally stops the thread on every exit.
+        # Spans (obs/trace.py) mirror the Trainer's epoch/step/drain
+        # attribution; no-ops unless the tracer is enabled (--trace).
         tel = FeedTelemetry()
-        feed = DevicePrefetcher(train_data(epoch), mesh,
-                                depth=prefetch_depth, telemetry=tel)
-        try:
-            for i, device_batch in enumerate(feed):
-                state, metrics = step(state, device_batch, next(keys))
-                pending.append(metrics)
-                # beats land only in drain() (per COMPLETED step) — a
-                # dispatch-side beat would mask a wedged device until the
-                # dispatch queue itself blocked; cadence bounded at 32
-                # batches regardless of log_every (same fix as Trainer)
-                if watchdog is not None \
-                        and i % min(32, log_every or 32) == 0:
-                    drain()
-                if log_every and i % log_every == 0:
-                    drain()  # syncs mostly-finished work; O(n) total
-                    print(f"[epoch {epoch} batch {i}] " + " ".join(
-                        f"{k}={v:.4f}"
-                        for k, v in sorted(fetched[-1].items())
-                    ), flush=True)
-        finally:
-            feed.close()
-        drain()  # drains the dispatch queue — MUST precede the timing read
+        with span("epoch", cat="train", args={"epoch": int(epoch)}):
+            feed = DevicePrefetcher(train_data(epoch), mesh,
+                                    depth=prefetch_depth, telemetry=tel)
+            try:
+                for i, device_batch in enumerate(feed):
+                    with span("step", cat="train"):
+                        state, metrics = step(state, device_batch,
+                                              next(keys))
+                        pending.append(metrics)
+                    # beats land only in drain() (per COMPLETED step) — a
+                    # dispatch-side beat would mask a wedged device until
+                    # the dispatch queue itself blocked; cadence bounded
+                    # at 32 batches regardless of log_every (same fix as
+                    # Trainer)
+                    if watchdog is not None \
+                            and i % min(32, log_every or 32) == 0:
+                        drain()
+                    if log_every and i % log_every == 0:
+                        drain()  # syncs mostly-finished work; O(n) total
+                        print(f"[epoch {epoch} batch {i}] " + " ".join(
+                            f"{k}={v:.4f}"
+                            for k, v in sorted(fetched[-1].items())
+                        ), flush=True)
+            finally:
+                feed.close()
+            drain()  # drains the dispatch queue — precedes the timing read
         epoch_metrics = {
             k: float(np.mean([m[k] for m in fetched]))
             for k in (fetched[0] if fetched else {})
@@ -575,7 +585,8 @@ def _gan_epoch_loop(state, step, train_data, mesh, start_epoch, epochs,
         ) + f" time={time.time() - t0:.1f}s", flush=True)
         stop = preempt is not None and preempt()
         if (epoch + 1) % save_every == 0 or epoch == epochs - 1 or stop:
-            mgr.save(epoch, state, loggers=loggers)
+            with span("checkpoint", cat="train"):
+                mgr.save(epoch, state, loggers=loggers)
         if stop:
             print(f"[preempted] after completed epoch {epoch}", flush=True)
             break

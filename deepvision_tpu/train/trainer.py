@@ -37,6 +37,8 @@ from deepvision_tpu.core.step import (
     compile_train_step,
 )
 from deepvision_tpu.data.prefetch import DevicePrefetcher, FeedTelemetry
+from deepvision_tpu.obs.profiler import ProfileWindow, sample_memory_gauges
+from deepvision_tpu.obs.trace import span
 from deepvision_tpu.resilience.recovery import (
     NumericDivergence,
     RecoveryCounters,
@@ -151,6 +153,8 @@ class Trainer:
         recovery=None,
         fault_injector=None,
         ckpt_integrity: bool = True,
+        profile_steps: str | None = None,
+        profile_dir: str | Path | None = None,
     ):
         self.model = model
         self.config = config
@@ -275,6 +279,18 @@ class Trainer:
         if self.rss_limit_bytes is not None:
             _check_rss_limit_sane(self.rss_limit_bytes)
         self._rss_preempted = False
+        # observability (obs/): an opt-in jax.profiler window over
+        # global steps A..B (--profile-steps), and a monotonic
+        # transferred-batch counter feeding it. Span tracing needs no
+        # state here — the loops emit through the process tracer, which
+        # the CLI enables/exports (--trace).
+        self._profiler = (
+            ProfileWindow(profile_steps,
+                          Path(profile_dir) if profile_dir
+                          else self.workdir / "profile")
+            if profile_steps else None
+        )
+        self._global_step = 0
         # per-epoch KeySeq derived in train_epoch from this root key
         self._base_key = jax.random.key(seed + 1)
 
@@ -549,11 +565,14 @@ class Trainer:
             # fetch so a long epoch-end drain of the dispatch queue (or
             # a blocking save) cannot trip the watchdog, and a wedged
             # device is detected even while dispatches still enqueue
-            for m in pending:
-                fetched.append({k: float(v) for k, v in m.items()})
-                if self._watchdog:
-                    self._watchdog.beat()
-            pending.clear()
+            if not pending:
+                return
+            with span("drain", cat="train"):
+                for m in pending:
+                    fetched.append({k: float(v) for k, v in m.items()})
+                    if self._watchdog:
+                        self._watchdog.beat()
+                pending.clear()
 
         def counted():
             for j, batch in enumerate(self.train_data(epoch)):
@@ -577,74 +596,99 @@ class Trainer:
         # epoch wall time into host-wait / H2D-wait / step-compute.
         # close() in the finally stops the producer thread on EVERY exit
         # (preemption return, upstream exception), not just exhaustion.
+        # span attribution (obs/trace.py): "epoch" is the wall-clock
+        # window tools/trace_summary.py attributes; "step"/"fetch"/
+        # "drain" (+ the producer thread's host_next/shard) are the
+        # leaves inside it. All no-ops unless the tracer is enabled
+        # (train.py --trace). NOTE on async backends (TPU): the "step"
+        # span deliberately does NOT device_sync — a per-step block
+        # would serialize the overlapped feed this loop exists for —
+        # so it measures dispatch + queue backpressure (converging to
+        # true step time once the dispatch queue fills), and the
+        # residual compute drains into the "drain" spans; exact
+        # per-step device time is --profile-steps' job.
         tel = FeedTelemetry()
-        feed = DevicePrefetcher(counted(), self.mesh,
-                                depth=self.prefetch_depth, telemetry=tel,
-                                fault_injector=self.injector,
-                                retry_policy=self.recovery,
-                                retry_counters=self.rec_counters)
-        try:
-            for i, device_batch in enumerate(feed):
-                for _ in range(self.data_echo):  # device-side batch reuse
-                    try:
-                        self.state, metrics = self._train_step(
-                            self.state, device_batch, next(keys)
-                        )
-                    except _checkify_error() as e:
-                        if self.recovery is None:
-                            raise  # fail fast, exactly as before
-                        # the tripwire fired: hand the position to the
-                        # rollback loop in _fit (restore last-good
-                        # checkpoint, skip past this batch window)
-                        raise NumericDivergence(
-                            epoch, start_step + i, e) from e
-                    pending.append(metrics)
-                # heartbeats land only in drain() (per COMPLETED step): a
-                # dispatch-side beat marks an ENQUEUED step, so a wedged
-                # device would keep "beating" until the dispatch queue
-                # blocked, stretching detection latency past the timeout.
-                # The watchdog forces its own drain cadence, bounded at 32
-                # batches regardless of log_every (log_every=500 would
-                # otherwise starve beats and false-trip healthy runs).
-                if self._watchdog \
-                        and i % min(32, self.log_every or 32) == 0:
-                    drain()
-                if (self.rss_limit_bytes
-                        and i % (self.log_every or 32) == 0):
-                    rss = _process_rss()
-                    if rss > self.rss_limit_bytes:
+        with span("epoch", cat="train", args={"epoch": int(epoch)}):
+            feed = DevicePrefetcher(counted(), self.mesh,
+                                    depth=self.prefetch_depth,
+                                    telemetry=tel,
+                                    fault_injector=self.injector,
+                                    retry_policy=self.recovery,
+                                    retry_counters=self.rec_counters)
+            try:
+                for i, device_batch in enumerate(feed):
+                    if self._profiler:  # --profile-steps window (obs/);
+                        # its own span: the start/stop XPlane dump costs
+                        # seconds and must attribute as profiler time,
+                        # not vanish from the epoch's span coverage
+                        with span("profiler", cat="train"):
+                            self._profiler.on_step(self._global_step)
+                    self._global_step += 1
+                    with span("step", cat="train"):
+                        for _ in range(self.data_echo):  # batch reuse
+                            try:
+                                self.state, metrics = self._train_step(
+                                    self.state, device_batch, next(keys)
+                                )
+                            except _checkify_error() as e:
+                                if self.recovery is None:
+                                    raise  # fail fast, exactly as before
+                                # the tripwire fired: hand the position
+                                # to the rollback loop in _fit (restore
+                                # last-good checkpoint, skip past this
+                                # batch window)
+                                raise NumericDivergence(
+                                    epoch, start_step + i, e) from e
+                            pending.append(metrics)
+                    # heartbeats land only in drain() (per COMPLETED
+                    # step): a dispatch-side beat marks an ENQUEUED step,
+                    # so a wedged device would keep "beating" until the
+                    # dispatch queue blocked, stretching detection
+                    # latency past the timeout. The watchdog forces its
+                    # own drain cadence, bounded at 32 batches regardless
+                    # of log_every (log_every=500 would otherwise starve
+                    # beats and false-trip healthy runs).
+                    if self._watchdog \
+                            and i % min(32, self.log_every or 32) == 0:
+                        drain()
+                    if (self.rss_limit_bytes
+                            and i % (self.log_every or 32) == 0):
+                        rss = _process_rss()
+                        if rss > self.rss_limit_bytes:
+                            print(
+                                f"[rss-limit] host RSS {rss/1e9:.2f}GB > "
+                                f"{self.rss_limit_bytes/1e9:.2f}GB — "
+                                "self-preempting (mid-epoch save; "
+                                "relaunch with --resume to continue in "
+                                "a fresh process)",
+                                flush=True,
+                            )
+                            self._rss_preempted = True
+                            self.request_preempt()
+                    if self._preempt:
+                        # batch-granular: the resume point is a
+                        # transferred-batch index, so a preemption
+                        # mid-echo-group replays the group
+                        drain()  # park the dispatch queue before saving
+                        self._save_preempt(epoch, start_step + i + 1)
+                        self.preempted = True
+                        return None
+                    if self.log_every and i % self.log_every == 0:
+                        drain()  # syncs mostly-finished work; O(n) total
+                        # true running mean over EVERY batch so far,
+                        # matching the reference
+                        # (ref: ResNet/pytorch/train.py:472-483)
+                        running = np.mean([m["loss"] for m in fetched])
                         print(
-                            f"[rss-limit] host RSS {rss/1e9:.2f}GB > "
-                            f"{self.rss_limit_bytes/1e9:.2f}GB — "
-                            "self-preempting (mid-epoch save; relaunch "
-                            "with --resume to continue in a fresh "
-                            "process)",
+                            f"[epoch {epoch} batch {i}] "
+                            f"loss={fetched[-1]['loss']:.4f} "
+                            f"running={running:.4f}",
                             flush=True,
                         )
-                        self._rss_preempted = True
-                        self.request_preempt()
-                if self._preempt:
-                    # batch-granular: the resume point is a transferred-
-                    # batch index, so a preemption mid-echo-group replays
-                    # the group
-                    drain()  # park the dispatch queue before serializing
-                    self._save_preempt(epoch, start_step + i + 1)
-                    self.preempted = True
-                    return None
-                if self.log_every and i % self.log_every == 0:
-                    drain()  # syncs mostly-finished work; O(n) total
-                    # true running mean over EVERY batch so far, matching
-                    # the reference (ref: ResNet/pytorch/train.py:472-483)
-                    running = np.mean([m["loss"] for m in fetched])
-                    print(
-                        f"[epoch {epoch} batch {i}] "
-                        f"loss={fetched[-1]['loss']:.4f} "
-                        f"running={running:.4f}",
-                        flush=True,
-                    )
-        finally:
-            feed.close()
-        drain()  # drains the dispatch queue — MUST precede the timing read
+            finally:
+                feed.close()
+            drain()  # drains the dispatch queue — MUST precede the
+            # timing read
         dt = time.perf_counter() - t0
         # throughput counts optimizer-processed samples; with echoing
         # each transferred image is processed data_echo times
@@ -692,6 +736,8 @@ class Trainer:
         finally:
             if self._watchdog:
                 self._watchdog.stop()
+            if self._profiler:  # close a still-open --profile-steps
+                self._profiler.close()  # window (run ended inside A:B)
             # grep-stable summaries on EVERY exit path (the chaos gate
             # asserts on these lines; operators read them post-mortem)
             if self.injector is not None:
@@ -753,7 +799,9 @@ class Trainer:
     def _fit(self, epochs: int | None = None) -> Loggers:
         total = epochs or self.config.get("total_epochs", 1)
         if self.start_epoch == 0 and self.start_step == 0:
-            val = self.validate()  # pre-train validation (ref: train.py:390)
+            with span("eval", cat="train"):
+                # pre-train validation (ref: train.py:390)
+                val = self.validate()
             if val:
                 self.loggers.log_metrics(-1, val)
                 print(f"[pre-train] {_fmt(val)}", flush=True)
@@ -777,11 +825,18 @@ class Trainer:
                 # cumulative self-healing counters ride the metric
                 # history (and TB): the run must SAY what it survived
                 tr.update(recovery_metrics(self.rec_counters))
+            # per-epoch HBM accounting (obs/profiler.py): mem_* gauges
+            # + logged metrics from device memory_stats(); {} on CPU
+            # backends, so CPU runs log exactly what they always did
+            mem = sample_memory_gauges()
+            if mem:
+                tr.update(mem)
             if start_step:
                 # honest history: this epoch's train aggregates cover only
                 # the post-resume tail of the epoch
                 tr["train_from_step"] = float(start_step)
-            val = self.validate()
+            with span("eval", cat="train"):
+                val = self.validate()
             epoch_metrics = {**tr, **val}
             self.loggers.log_metrics(epoch, epoch_metrics)
             for k, v in tr.items():
@@ -815,18 +870,20 @@ class Trainer:
                                                    scale)
                         )
                 self.best_metric = max(self.best_metric, metric)
-            self.ckpt.save(
-                epoch,
-                self.state,
-                loggers=self.loggers,
-                extra={"plateau": self.plateau.state_dict()}
-                if self.plateau else {},
-                best_metric=self.best_metric,
-                # metric-less partial epoch: rank at the current best so
-                # keep_best retention neither drops nor promotes it
-                metrics={"plateau_metric": float(
-                    metric if metric is not None else self.best_metric)},
-            )
+            with span("checkpoint", cat="train"):
+                self.ckpt.save(
+                    epoch,
+                    self.state,
+                    loggers=self.loggers,
+                    extra={"plateau": self.plateau.state_dict()}
+                    if self.plateau else {},
+                    best_metric=self.best_metric,
+                    # metric-less partial epoch: rank at the current best
+                    # so keep_best retention neither drops nor promotes it
+                    metrics={"plateau_metric": float(
+                        metric if metric is not None
+                        else self.best_metric)},
+                )
             # the epoch checkpoint supersedes any earlier preemption save —
             # but only once it is DURABLE: an async save has merely been
             # staged when save() returns, and deleting the preemption

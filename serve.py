@@ -10,7 +10,9 @@ surfaces (``deepvision_tpu/serve/``):
     # HTTP (stdlib http.server, no new deps)
     serve.py --http 8080 -m resnet50=runs/resnet50 -m yolov3=runs/yolov3
     POST /v1/predict   {"model": "resnet50", "input": [[...]]}  -> result
-    GET  /stats        engine telemetry + cache + queue snapshot
+    GET  /stats        engine telemetry + cache + queue snapshot (JSON)
+    GET  /metrics      Prometheus text exposition from the obs registry
+                       (serve_* counters/quantiles + mem_* gauges)
     GET  /healthz      "ok" once warmup completed
 
     # serve a StableHLO artifact from predict.py export
@@ -201,14 +203,19 @@ def make_handler(engine, args):
 
         def _send(self, code: int, payload: dict,
                   headers: dict | None = None):
-            body = json.dumps(payload).encode()
+            self._send_text(code, json.dumps(payload),
+                            "application/json", headers)
+
+        def _send_text(self, code: int, body: str, content_type: str,
+                       headers: dict | None = None) -> None:
+            data = body.encode()
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
-            self.wfile.write(body)
+            self.wfile.write(data)
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -219,7 +226,17 @@ def make_handler(engine, args):
                 h["models"] = models
                 self._send(200 if h["status"] == "ok" else 503, h)
             elif self.path == "/stats":
+                # /stats reads through the obs-backed telemetry
+                # snapshot: every histogram's (count, total, samples)
+                # triple is read under the metric's own lock, so a
+                # scrape landing mid-record can never see a torn
+                # count/total pair — the pre-obs snapshot only got that
+                # guarantee via the engine lock the handler didn't hold
                 self._send(200, engine.stats())
+            elif self.path == "/metrics":
+                self._send_text(200, _render_metrics(),
+                                "text/plain; version=0.0.4; "
+                                "charset=utf-8")
             else:
                 self._send(404, {"error": "not found"})
 
@@ -259,13 +276,25 @@ def make_handler(engine, args):
     return Handler
 
 
+def _render_metrics() -> str:
+    """Prometheus text for GET /metrics: the process obs registry
+    (serve_* counters + latency quantiles, plus whatever else this
+    process registered), with the mem_* device gauges refreshed per
+    scrape (one memory_stats() read per device; no-op on CPU)."""
+    from deepvision_tpu.obs.metrics import default_registry
+    from deepvision_tpu.obs.profiler import sample_memory_gauges
+
+    sample_memory_gauges()
+    return default_registry().render_prometheus()
+
+
 def run_http(engine, args):
     import http.server
 
     server = http.server.ThreadingHTTPServer(
         ("", args.http), make_handler(engine, args))
     print(f"listening on :{args.http} "
-          f"(POST /v1/predict, GET /stats, GET /healthz)",
+          f"(POST /v1/predict, GET /stats, GET /metrics, GET /healthz)",
           file=sys.stderr)
     try:
         server.serve_forever()
@@ -302,14 +331,23 @@ def main(argv=None):
                         "batch — the supervisor must recover)")
     p.add_argument("--fault-seed", type=int, default=0,
                    help="seed for probabilistic (~) fault specs")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the whole "
+                        "serving session into this directory (started "
+                        "after warmup, stopped at shutdown)")
     args = p.parse_args(argv)
+
+    from deepvision_tpu.obs.profiler import profile_session
 
     engine = build_engine(args)
     try:
-        if args.http is not None:
-            run_http(engine, args)
-        else:
-            run_stdin(engine, args)
+        # the profiler bracket starts AFTER build_engine so warmup
+        # compiles don't drown the serving steady state in the trace
+        with profile_session(args.profile_dir):
+            if args.http is not None:
+                run_http(engine, args)
+            else:
+                run_stdin(engine, args)
     finally:
         engine.close()
 

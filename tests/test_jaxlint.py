@@ -687,6 +687,89 @@ def test_load_config_reads_checked_step_funcs(tmp_path):
     assert "*_train_step" in LintConfig().checked_step_funcs
 
 
+# ----------------------------------------------------------- JX112
+
+
+def test_jx112_flags_unsynced_step_timing(tmp_path):
+    r = lint(tmp_path, "lib/bench.py", """
+        import time
+
+        def measure(state, batches, key):
+            t0 = time.perf_counter()
+            for b in batches:
+                state, m = my_train_step(state, b, key)
+            rate = 64 / (time.perf_counter() - t0)   # dispatch, not compute
+
+            t1 = time.time()
+            state, m = my_eval_step(state, b)
+            dt = time.time() - t1                    # same lie, time.time
+            return rate, dt
+        """)
+    assert codes(r) == ["JX112", "JX112"]
+    assert "block_until_ready" in r.findings[0].message
+
+
+def test_jx112_passes_synced_and_unrelated_timing(tmp_path):
+    r = lint(tmp_path, "lib/bench.py", """
+        import time
+        import jax
+
+        def measure(state, batches, key):
+            t0 = time.perf_counter()
+            for b in batches:
+                state, m = my_train_step(state, b, key)
+            jax.block_until_ready(state)             # drained: honest
+            rate = 64 / (time.perf_counter() - t0)
+
+            t1 = time.perf_counter()
+            state, m = my_train_step(state, b, key)
+            host = jax.device_get(m)                 # fetch = sync too
+            dt = time.perf_counter() - t1
+
+            t2 = time.perf_counter()
+            records = load_batch(b)                  # no step call timed
+            io_s = time.perf_counter() - t2
+
+            t3 = time.perf_counter()
+            state, m = my_train_step(state, b, key)
+            m["loss"].block_until_ready()            # method-form sync
+            step_s = time.perf_counter() - t3
+            return rate, dt, io_s, step_s, host
+        """)
+    assert codes(r) == []
+
+
+def test_jx112_timed_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(timed_funcs=["run_compiled*"])
+    r = lint(tmp_path, "lib/bench.py", """
+        import time
+
+        def measure(state, b):
+            t0 = time.perf_counter()
+            y = run_compiled_fwd(state, b)           # matched by knob
+            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            state, m = my_train_step(state, b)       # NOT matched now
+            dt2 = time.perf_counter() - t1
+            return y, m, dt, dt2
+        """, cfg=cfg)
+    assert codes(r) == ["JX112"]
+
+
+def test_load_config_reads_timed_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        timed_funcs = ["run_compiled*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.timed_funcs == ["run_compiled*"]
+    # defaults cover the repo's step-call naming, same set as JX111
+    assert "*_train_step" in LintConfig().timed_funcs
+
+
 # ------------------------------------------- suppression + baseline
 
 

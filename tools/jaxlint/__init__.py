@@ -26,6 +26,9 @@ Checker codes (tools/jaxlint/checkers.py):
            (per-request retrace/compile on the serving path)
     JX111  broad 'except Exception'/bare except around a compiled-step
            call (swallows the checkify NaN/Inf tripwire)
+    JX112  time.time()/perf_counter() delta around a compiled-step call
+           without block_until_ready between call and stop (async
+           dispatch: the delta times enqueue, not compute)
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

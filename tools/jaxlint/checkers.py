@@ -907,3 +907,91 @@ class BroadExceptStepChecker(Checker):
                     and sub.exc.id == handler.name:
                 return True
         return False
+
+
+_TIMER_CALLS = {"time.time", "time.perf_counter", "perf_counter"}
+# calls that drain the async dispatch queue (or fetch through it), so a
+# clock read after one measures completed compute, not enqueue
+_DISPATCH_SYNC_ATTRS = {"block_until_ready", "device_get",
+                        "effects_barrier"}
+
+
+@register_checker
+class AsyncDispatchTimingChecker(Checker):
+    """``time.time()``/``time.perf_counter()`` deltas taken around a
+    compiled-step call with no ``block_until_ready()`` between call and
+    stop: JAX dispatch is ASYNC — the compiled call returns the moment
+    the work is enqueued, so the delta times dispatch (microseconds)
+    while the chip is still computing. Such "throughput" numbers are
+    lies, often by 10-100x (bench.py documents measured 8x-over-peak
+    artifacts from exactly this). Which call names count as compiled
+    steps is the ``timed_funcs`` knob (``jaxlint.toml``); syncs
+    recognized between call and clock read: ``block_until_ready`` /
+    ``jax.block_until_ready``, ``jax.device_get``,
+    ``jax.effects_barrier``. Fetch-based drains a linter cannot see
+    through (the Trainer's ``drain()`` float()s every pending metric)
+    are what the ``[[baseline]]`` ledger is for."""
+
+    code = "JX112"
+    name = "async-dispatch-timing"
+    description = ("time.time()/perf_counter() delta around a "
+                   "compiled-step call without block_until_ready "
+                   "between call and stop (times dispatch, not compute)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.timed_funcs
+        for info in mod.functions:
+            if info.parent is not None:
+                continue  # nested defs scan with their parent
+            yield from self._scan(mod, info.node, patterns)
+
+    def _scan(self, mod: ModuleContext, func: FunctionNode,
+              patterns) -> Iterator[Finding]:
+        """Textual-order event scan of one function (nested defs
+        included — closures run roughly where they're used, the same
+        approximation the key-reuse scan makes)."""
+        starts: list[tuple[int, str]] = []    # (line, t0 name)
+        steps: list[tuple[int, str]] = []     # (line, call name)
+        syncs: list[int] = []                 # lines
+        deltas: list[tuple[ast.AST, int, str]] = []  # (node, line, t0)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and call_name(node.value) in _TIMER_CALLS:
+                for name in assign_target_names(node):
+                    starts.append((node.lineno, name))
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                la = last_attr(cn)
+                if la in _DISPATCH_SYNC_ATTRS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _DISPATCH_SYNC_ATTRS):
+                    syncs.append(node.lineno)
+                elif la and any(fnmatch.fnmatch(la, p)
+                                for p in patterns):
+                    steps.append((node.lineno, cn))
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Sub) \
+                    and isinstance(node.left, ast.Call) \
+                    and call_name(node.left) in _TIMER_CALLS \
+                    and isinstance(node.right, ast.Name):
+                deltas.append((node, node.lineno, node.right.id))
+        for node, stop_line, t0 in deltas:
+            start_line = max((ln for ln, n in starts
+                              if n == t0 and ln < stop_line), default=None)
+            if start_line is None:
+                continue  # t0 isn't a visible timer start
+            timed_steps = [(ln, cn) for ln, cn in steps
+                           if start_line < ln < stop_line]
+            if not timed_steps:
+                continue
+            last_step_line, step_name = max(timed_steps)
+            if any(last_step_line < ln < stop_line for ln in syncs):
+                continue  # synced between call and stop: honest timing
+            yield mod.finding(
+                node, self.code,
+                f"clock delta over compiled-step call '{step_name}' "
+                "with no block_until_ready between call and stop — "
+                "async dispatch makes this time enqueue, not compute; "
+                "sync the result (jax.block_until_ready) before "
+                "reading the clock")

@@ -257,6 +257,15 @@ class LintConfig:
         "*_train_step", "*_eval_step", "*_step_fn", "train_step",
         "eval_step",
     ])
+    # Call-name patterns treated as compiled-step invocations for the
+    # async-dispatch timing check (JX112): a time.time()/perf_counter()
+    # delta spanning one of these without a block_until_ready between
+    # call and stop times ENQUEUE, not compute — the classic 10-100x
+    # throughput lie on an async backend.
+    timed_funcs: list[str] = field(default_factory=lambda: [
+        "*_train_step", "*_eval_step", "*_step_fn", "train_step",
+        "eval_step",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -275,7 +284,8 @@ def load_config(path: str | Path | None) -> LintConfig:
         "traced_dirs", "data_dirs", "parallel_dirs",
         "traced_name_patterns", "jit_wrappers", "static_return_calls",
         "key_fresheners", "key_name_patterns", "constraint_funcs",
-        "prefetch_funcs", "serve_funcs", "checked_step_funcs", "disable",
+        "prefetch_funcs", "serve_funcs", "checked_step_funcs",
+        "timed_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""``make obs-smoke`` HTTP leg: GET /metrics from an in-process server
+and assert the Prometheus text exposition actually parses.
+
+Boots the real lenet5 serving stack (ServedModel -> InferenceEngine ->
+serve.py's handler) on an ephemeral port, pushes a few requests through
+the engine, then:
+
+1. GETs ``/metrics`` and validates EVERY line against the exposition
+   format (``# TYPE``/``# HELP`` comments, or ``name[{labels}] value``)
+   — a malformed line is exactly what a Prometheus scraper would choke
+   on;
+2. asserts the ``serve_*`` families rendered from the obs registry
+   (counter with the completed requests, latency summary with quantile
+   samples and a coherent _count);
+3. GETs ``/stats`` and asserts the pre-obs JSON keys are still there
+   byte-for-byte (the compat contract the registry refactor must keep).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import http.server
+import json
+import re
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+if __package__ in (None, ""):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# one metric sample: name, optional {labels}, a float (inf/nan allowed)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*"
+    r"=\"[^\"]*\")*\})?"
+    r" [-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[Ii]nf|[Nn]a[Nn])$")
+_COMMENT_RE = re.compile(r"^# (TYPE|HELP) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+_STATS_KEYS = {  # the PR 3 /stats telemetry contract
+    "submitted", "completed", "timed_out", "failed", "shed", "batches",
+    "rows", "padded_rows", "dispatcher_crashes", "dispatcher_restarts",
+    "pad_overhead_frac", "mean_batch_rows", "queue_wait", "device_time",
+    "e2e_latency",
+}
+
+
+def main() -> int:
+    import argparse
+
+    import numpy as np
+
+    import serve as serve_cli
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.serve import InferenceEngine
+    from deepvision_tpu.serve.models import load_served
+
+    with contextlib.redirect_stdout(sys.stderr):  # restore chatter
+        served = load_served("lenet5", None, num_classes=10)
+    engine = InferenceEngine([served], mesh=create_mesh(1, 1),
+                             buckets=(1, 4))
+    server = None
+    try:
+        t0 = time.perf_counter()
+        for i in range(3):
+            engine.submit(
+                np.zeros((32, 32, 1), np.float32)).result(timeout=60)
+        print(f"3 requests served in "
+              f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
+
+        handler = serve_cli.make_handler(
+            engine, argparse.Namespace(timeout_s=10.0))
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                 handler)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read().decode()
+        assert "text/plain" in ctype, f"bad content type {ctype!r}"
+        lines = [ln for ln in body.splitlines() if ln.strip()]
+        bad = [ln for ln in lines
+               if not (_COMMENT_RE.match(ln) or _SAMPLE_RE.match(ln))]
+        assert not bad, f"non-exposition-format lines: {bad[:5]}"
+
+        samples = {}
+        for ln in lines:
+            if ln.startswith("#"):
+                continue
+            name, _, value = ln.partition(" ")
+            samples[name] = float(value)
+        assert samples.get("serve_completed_total", 0) >= 3, samples
+        assert 'serve_e2e_latency{quantile="0.5"}' in samples, \
+            "latency summary quantiles missing"
+        assert samples.get("serve_e2e_latency_count", 0) >= 3
+        assert samples["serve_e2e_latency_sum"] > 0
+
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        missing = _STATS_KEYS - set(stats["telemetry"])
+        assert not missing, f"/stats lost keys: {missing}"
+        assert stats["telemetry"]["completed"] >= 3
+
+        print(f"obs-smoke /metrics OK ({len(lines)} exposition lines, "
+              f"{len(samples)} samples, /stats keys intact)")
+        return 0
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        engine.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Per-span time-attribution table from an exported obs trace.
+
+    python tools/trace_summary.py runs/obs-smoke/trace.json
+    python tools/trace_summary.py trace.json --wall epoch \
+        --assert-spans fetch,step --min-coverage 0.95
+
+Reads the Chrome-trace JSON that ``train.py --trace`` /
+``BENCH_TRACE`` export (``deepvision_tpu/obs/trace.py``) and prints,
+per span name: count, total/mean/max milliseconds, and the share of the
+wall window. The wall window is the union of the ``--wall`` spans
+(default ``epoch`` — the trainer's outermost per-epoch span);
+"attributed" is the union of every OTHER span's intervals on the wall
+threads clipped to that window, so nesting and overlap never
+double-count — the honest answer to "what did the epochs spend their
+time on".
+
+``--assert-spans`` / ``--min-coverage`` make it a gate: ``make
+obs-smoke`` asserts the fetch/step spans exist and the attribution
+holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+if __package__ in (None, ""):  # `python tools/trace_summary.py ...`
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from deepvision_tpu.obs.trace import summarize_chrome  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/trace_summary.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("trace", help="Chrome-trace JSON (train.py --trace)")
+    p.add_argument("--wall", default="epoch",
+                   help="span name whose total duration is the wall "
+                        "clock being attributed (default: epoch)")
+    p.add_argument("--assert-spans", default=None, metavar="A,B,...",
+                   help="fail unless every named span appears")
+    p.add_argument("--min-coverage", type=float, default=None,
+                   help="fail unless attributed/wall >= this fraction")
+    args = p.parse_args(argv)
+
+    data = json.loads(Path(args.trace).read_text())
+    s = summarize_chrome(data, wall_span=args.wall)
+
+    if not s["spans"]:
+        print(f"{args.trace}: no span events (was tracing enabled?)",
+              file=sys.stderr)
+        return 1
+    print(f"{'span':<14} {'count':>6} {'total_ms':>10} {'mean_ms':>9} "
+          f"{'max_ms':>9} {'% wall':>7}")
+    for name, d in s["spans"].items():
+        print(f"{name:<14} {d['count']:>6} {d['total_ms']:>10.1f} "
+              f"{d['mean_ms']:>9.2f} {d['max_ms']:>9.1f} "
+              f"{d['pct_of_wall']:>6.1f}%")
+    print(f"wall ({s['wall_span']}): {s['wall_ms']:.1f} ms; attributed "
+          f"{s['attributed_ms']:.1f} ms to named spans "
+          f"({s['coverage'] * 100:.1f}%)")
+
+    rc = 0
+    if args.assert_spans:
+        missing = [n for n in args.assert_spans.split(",")
+                   if n.strip() and n.strip() not in s["spans"]]
+        if missing:
+            print(f"FAIL: missing span(s): {', '.join(missing)}",
+                  file=sys.stderr)
+            rc = 1
+    if args.min_coverage is not None \
+            and s["coverage"] < args.min_coverage:
+        print(f"FAIL: coverage {s['coverage']:.4f} < "
+              f"{args.min_coverage}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

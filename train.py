@@ -138,6 +138,21 @@ def parse_args():
                         "echoing, arXiv:1907.05550) — multiplies step "
                         "throughput when the input pipeline or H2D "
                         "link, not the chip, is the bottleneck")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="enable obs span tracing (epoch/step/fetch/"
+                        "eval/checkpoint + the feed producer's "
+                        "host_next/shard) and export a Chrome-trace "
+                        "JSON here on exit (chrome://tracing / "
+                        "Perfetto; summarize with "
+                        "tools/trace_summary.py)")
+    p.add_argument("--profile-steps", default=None, metavar="A:B",
+                   help="capture a jax.profiler trace over global "
+                        "steps A..B (transferred-batch indices, "
+                        "0-based) — a bounded window instead of "
+                        "gigabytes of whole-run XPlane")
+    p.add_argument("--profile-dir", default=None,
+                   help="where --profile-steps writes the profiler "
+                        "trace (default: WORKDIR/MODEL/profile)")
     p.add_argument("--prefetch-depth", type=int, default=2,
                    help="device batches the async feed keeps in flight "
                         "ahead of the step (data/prefetch.py); 1 = "
@@ -202,12 +217,18 @@ def main():
     if args.lr_rewarm is not None and not args.recover:
         raise SystemExit("--lr-rewarm only applies with --recover "
                          "(it scales the LR on each rollback)")
+    _maybe_enable_trace(args)
     if cfg["dataset"].startswith("gan"):
         if args.recover or args.faults:
             raise SystemExit(
                 "--recover/--faults ride the Trainer rollback loop; the "
                 "GAN fit_gan path has no checkpoint-rollback hook yet "
                 f"(this run: {args.model!r})")
+        if args.profile_steps or args.profile_dir:
+            raise SystemExit(
+                "--profile-steps/--profile-dir ride the Trainer step "
+                "counter; the GAN fit_gan path has no profiler hook "
+                f"yet (this run: {args.model!r}; --trace works)")
         run_gan(args, cfg, dtype)
         return
     if cfg["dataset"] == "pose":
@@ -416,7 +437,9 @@ def main():
         stall_abort=args.stall_abort,
         rss_limit_gb=args.rss_limit_gb or None,
         recovery=recovery, fault_injector=injector,
-        ckpt_integrity=not args.no_ckpt_integrity, **step_fns,
+        ckpt_integrity=not args.no_ckpt_integrity,
+        profile_steps=args.profile_steps, profile_dir=args.profile_dir,
+        **step_fns,
     )
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
@@ -428,10 +451,35 @@ def main():
     # continues bit-identically (SURVEY §5.3 — the reference has no
     # preemption story at all)
     trainer.install_preemption_handler()
-    trainer.fit(args.epochs)
+    try:
+        trainer.fit(args.epochs)
+    finally:
+        # export on EVERY exit (preemption and crashes included): a
+        # truncated run's trace is exactly the one worth reading
+        _maybe_export_trace(args)
     if trainer.preempted:
         raise SystemExit(143)
     _maybe_publish(args, f"{args.workdir}/{args.model}/ckpt")
+
+
+def _maybe_enable_trace(args) -> None:
+    if not args.trace:
+        return
+    from deepvision_tpu.obs.trace import get_tracer
+
+    get_tracer().enable()
+    print(f"[obs] span tracing on -> {args.trace}", flush=True)
+
+
+def _maybe_export_trace(args) -> None:
+    if not args.trace:
+        return
+    from deepvision_tpu.obs.trace import get_tracer
+
+    n = get_tracer().export(args.trace)
+    print(f"[obs] wrote {n} spans to {args.trace} "
+          "(load in chrome://tracing or Perfetto; summarize with "
+          "tools/trace_summary.py)", flush=True)
 
 
 def _localize_batches(data_fn, nproc: int, pid: int):
@@ -575,19 +623,22 @@ def run_gan(args, cfg, dtype):
         preempted = lambda: sigterm() or rss_exceeded()  # noqa: E731
     watchdog = (StallWatchdog(args.stall_timeout, abort=args.stall_abort)
                 if args.stall_timeout else None)
-    fit_gan(
-        state, step_fn, train_data, mesh,
-        epochs=epochs, workdir=workdir,
-        save_every=cfg.get("save_every", 2),
-        resume=args.resume or args.checkpoint is not None,
-        resume_epoch=args.checkpoint,
-        check_numerics=args.check_numerics,
-        shard_weight_update=args.shard_weight_update,
-        async_checkpoint=args.async_checkpoint,
-        preempt=preempted,
-        watchdog=watchdog,
-        prefetch_depth=args.prefetch_depth,
-    )
+    try:
+        fit_gan(
+            state, step_fn, train_data, mesh,
+            epochs=epochs, workdir=workdir,
+            save_every=cfg.get("save_every", 2),
+            resume=args.resume or args.checkpoint is not None,
+            resume_epoch=args.checkpoint,
+            check_numerics=args.check_numerics,
+            shard_weight_update=args.shard_weight_update,
+            async_checkpoint=args.async_checkpoint,
+            preempt=preempted,
+            watchdog=watchdog,
+            prefetch_depth=args.prefetch_depth,
+        )
+    finally:
+        _maybe_export_trace(args)  # same every-exit contract as main()
     if preempted():
         raise SystemExit(143)
     _maybe_publish(args, f"{workdir}/ckpt")
