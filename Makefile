@@ -64,6 +64,28 @@ serve-smoke:
 	    assert len(ok) == 3, rows; \
 	    print('serve-smoke OK (3/3 responses)')"
 
+# router smoke: boot a 2-replica lenet process fleet behind the router
+# (serve.py --fleet), stream 24 JSONL requests through it while the
+# chaos schedule SIGKILLs one replica at routed-request #5, and assert
+# (1) zero lost requests — every request gets a result, the killed
+# one(s) via failover — and (2) the grep-stable `[router] failovers=N`
+# exit line: the `make check` fleet-availability gate
+router-smoke:
+	@mkdir -p logs; L="logs/router-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	$(PY) -c "import json, numpy as np; \
+	    [print(json.dumps({'id': i, 'model': 'lenet5', \
+	     'input': np.zeros((32, 32, 1)).tolist()})) for i in range(24)]" \
+	| $(PY) serve.py --fleet 2 -m lenet5 --buckets 1,4 \
+	    --faults replica_kill@5 2> "$$L" \
+	| $(PY) -c "import sys, json; \
+	    rows = [json.loads(l) for l in sys.stdin if l.strip()]; \
+	    ok = [r for r in rows if 'result' in r]; \
+	    assert len(ok) == 24, (len(ok), rows[:3]); \
+	    print('router-smoke stream OK (24/24 responses)')" && \
+	grep -qE "\[router\] failovers=[1-9]" "$$L" && \
+	grep -qE "deaths=1" "$$L" && \
+	echo "router-smoke OK (replica SIGKILLed, failover line present)"
+
 # observability smoke: train 2 synthetic lenet epochs with span tracing
 # on, assert the exported Chrome trace carries the fetch/step/eval/
 # checkpoint spans and attributes >= 95% of epoch wall time to named
@@ -103,7 +125,7 @@ chaos-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke obs-smoke chaos-smoke
+check: lint serve-smoke router-smoke obs-smoke chaos-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -227,4 +249,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint check serve-smoke obs-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint check serve-smoke router-smoke obs-smoke bench dryrun tensorboard find-python list-models rehearsal

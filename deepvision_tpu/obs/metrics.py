@@ -246,6 +246,15 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def value_of(self, name: str, default: float = 0.0) -> float:
+        """Scalar read of a counter/gauge by name (``default`` when the
+        metric is absent or a histogram) — the one-liner signal readers
+        like the serving autoscaler use to consume registry gauges."""
+        m = self.get(name)
+        if isinstance(m, (Counter, Gauge)):
+            return float(m.value)
+        return default
+
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._metrics)

@@ -36,6 +36,14 @@ Sites and their consultation points:
 ``dispatch_crash``  per dispatched serve batch (``serve/engine.py``);
                     fires by raising :class:`InjectedCrash` in the
                     dispatcher loop body. Alias: ``crash``.
+``replica_kill``    per routed request attempt (``serve/router.py``);
+                    fires by hard-killing the chosen replica (SIGKILL
+                    for process replicas) BEFORE the attempt is sent,
+                    so the router's dead-replica failover path runs.
+                    Alias: ``rkill``.
+``replica_slow``    per routed request attempt; fires by injecting
+                    ``ARG`` seconds of extra attempt latency (default
+                    0.5) — exercises hedged retries. Alias: ``rslow``.
 ==================  =====================================================
 
 Example: ``"nan@14,ckpt@1,io@8x2"`` — NaN-poison the 15th train batch,
@@ -62,12 +70,15 @@ __all__ = [
 ]
 
 # canonical site names + accepted aliases
-SITES = ("nan_step", "data_io", "ckpt_corrupt", "stall", "dispatch_crash")
+SITES = ("nan_step", "data_io", "ckpt_corrupt", "stall", "dispatch_crash",
+         "replica_kill", "replica_slow")
 _ALIASES = {
     "nan": "nan_step", "nan_grad": "nan_step",
     "io": "data_io",
     "ckpt": "ckpt_corrupt",
     "crash": "dispatch_crash",
+    "rkill": "replica_kill",
+    "rslow": "replica_slow",
 }
 
 
@@ -246,6 +257,21 @@ class FaultInjector:
             raise InjectedCrash(
                 "injected dispatcher crash "
                 f"(occurrence {self._counts['dispatch_crash'] - 1})")
+
+    def check_replica_kill(self) -> bool:
+        """Router hook, per routed request attempt: True when the chosen
+        replica should be hard-killed before the attempt is sent (the
+        router then exercises its real dead-replica failover path)."""
+        return self._consult("replica_kill") is not None
+
+    def check_replica_slow(self) -> float | None:
+        """Router hook, per routed request attempt: extra attempt
+        latency in seconds (``:ARG``, default 0.5) when scheduled, else
+        None — slow enough attempts trip the router's hedged retry."""
+        spec = self._consult("replica_slow")
+        if spec is None:
+            return None
+        return spec.arg if spec.arg is not None else 0.5
 
     def corrupt_checkpoint(self, step_dir: str | Path) -> bool:
         """Checkpoint hook, per committed save: garble the largest file

@@ -17,13 +17,24 @@ The serving runtime layer (ROADMAP north star: "serves heavy traffic"):
                       path (classify/detect/pose/gan) shared by
                       ``predict.py`` and the server; also wraps
                       StableHLO artifacts from ``export.py``.
-- ``admission``     : queue-depth backpressure, per-model limits, and
+- ``admission``     : queue-depth backpressure, per-model limits,
+                      SLO-aware deadline budgets, and
                       reject-with-retry-after shedding.
 - ``telemetry``     : queue-wait / pad-overhead / device-time / e2e
-                      histograms with p50/p95/p99 snapshots.
+                      histograms with p50/p95/p99 snapshots, plus the
+                      fleet router's ``router_*`` metrics.
+- ``replica``       : the fleet's unit of capacity — in-process engine
+                      replicas (fast tests) and ``serve.py`` child
+                      processes (production / chaos drills).
+- ``router``        : SLO-aware front tier over N supervised replicas —
+                      health-gated load balancing, hedged failover with
+                      exactly-once results, per-model circuit breaker +
+                      error budget, metric-driven autoscaling.
 
-The CLI lives at the repo root (``serve.py``: stdin-JSONL and HTTP);
-``bench.py serve`` measures offered load vs achieved throughput.
+The CLI lives at the repo root (``serve.py``: stdin-JSONL and HTTP,
+single-engine or ``--fleet N``); ``bench.py serve`` measures offered
+load vs achieved throughput, ``bench.py serve --sweep`` the fleet's
+latency-throughput curve + SIGKILL chaos drill.
 """
 
 from deepvision_tpu.serve.admission import AdmissionController, ShedError
@@ -35,7 +46,24 @@ from deepvision_tpu.serve.models import (
     load_served,
     restore_state,
 )
-from deepvision_tpu.serve.telemetry import LatencyStats, ServeTelemetry
+from deepvision_tpu.serve.replica import (
+    EngineReplica,
+    ProcessReplica,
+    ReplicaDeadError,
+)
+from deepvision_tpu.serve.router import (
+    AutoscaleConfig,
+    Autoscaler,
+    CircuitBreaker,
+    CircuitConfig,
+    FleetRouter,
+    RouterShedError,
+)
+from deepvision_tpu.serve.telemetry import (
+    LatencyStats,
+    RouterTelemetry,
+    ServeTelemetry,
+)
 
 __all__ = [
     "AdmissionController",
@@ -46,6 +74,16 @@ __all__ = [
     "from_stablehlo",
     "load_served",
     "restore_state",
+    "EngineReplica",
+    "ProcessReplica",
+    "ReplicaDeadError",
+    "AutoscaleConfig",
+    "Autoscaler",
+    "CircuitBreaker",
+    "CircuitConfig",
+    "FleetRouter",
+    "RouterShedError",
     "LatencyStats",
+    "RouterTelemetry",
     "ServeTelemetry",
 ]

@@ -37,6 +37,12 @@ class AdmissionController:
       models; the engine's worst-case memory and latency bound.
     - ``per_model_limit``: optional cap per model, so one hot model
       cannot starve the rest of the host's queue budget.
+    - ``slo_budget_s``: optional per-model p95 deadline budgets (the
+      router's SLO table). Admission becomes SLO-aware: a request whose
+      ESTIMATED wait (backlog depth x the service-time EWMA) already
+      exceeds its model's budget is shed at the door — queueing it
+      could only produce a late answer, and the shed's retry hint is
+      honest about when capacity returns.
 
     ``observe_batch`` maintains an EWMA of per-row service time; the
     shed hint is ``depth × row_s`` — how long the current backlog needs
@@ -45,11 +51,13 @@ class AdmissionController:
 
     def __init__(self, max_queue: int = 256,
                  per_model_limit: int | None = None,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2,
+                 slo_budget_s: dict[str, float] | None = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_queue = max_queue
         self.per_model_limit = per_model_limit
+        self.slo_budget_s = dict(slo_budget_s or {})
         self._alpha = ewma_alpha
         self._lock = threading.Lock()
         self._counts: dict[str, int] = {}
@@ -70,6 +78,14 @@ class AdmissionController:
                     f"model {model!r} at its concurrency limit "
                     f"({self.per_model_limit})",
                     self._retry_after_locked())
+            budget = self.slo_budget_s.get(model)
+            if budget is not None:
+                est_wait = self._total * self._row_s
+                if est_wait > budget:
+                    raise ShedError(
+                        f"estimated queue wait {est_wait:.3f}s exceeds "
+                        f"model {model!r} p95 budget {budget}s",
+                        self._retry_after_locked())
             self._counts[model] = self._counts.get(model, 0) + 1
             self._total += 1
 
@@ -106,4 +122,6 @@ class AdmissionController:
                 "per_model_limit": self.per_model_limit,
                 "per_model_depth": dict(self._counts),
                 "ewma_row_ms": round(self._row_s * 1e3, 3),
+                **({"slo_budget_s": dict(self.slo_budget_s)}
+                   if self.slo_budget_s else {}),
             }

@@ -140,6 +140,7 @@ class InferenceEngine:
             name: [] for name in self._models}
         self._in_flight: list[_Request] = []
         self._recovering = threading.Event()
+        self._recover_until = 0.0  # monotonic end of the backoff window
         self._injector = fault_injector
         self._restart_backoff_s = restart_backoff_s
         self._restart_backoff_max_s = restart_backoff_max_s
@@ -181,15 +182,35 @@ class InferenceEngine:
 
     def warm(self) -> None:
         """Eagerly compile every (model, bucket) executable so no
-        request ever pays a trace; time recorded in ``warmup_s``."""
+        request ever pays a trace; time recorded in ``warmup_s``.
+
+        Precompiled (StableHLO-artifact) runners additionally execute
+        once on a zero batch fed through the EXACT request path
+        (``device_put`` with the batch sharding): ``jax.export``
+        serializes StableHLO, not machine code, so the deserialized
+        callable compiles for the local backend on first call — and it
+        specializes on the input's placement, so a numpy-fed warmup
+        would leave the device-array-fed request path still cold.
+        Without this, the engine's "no request pays a compile" contract
+        silently broke for artifacts (measured as a multi-second stall
+        of the first request burst on every fresh replica)."""
+        import jax
+
+        from deepvision_tpu.core.mesh import data_sharding
+
         t0 = time.perf_counter()
         for m in self._models.values():
             for bucket in self.ladder(m):
-                self._cache.get_or_build(
+                runner = self._cache.get_or_build(
                     (m.name, bucket, m.dtype_str),
                     lambda m=m, bucket=bucket: m.compile_for(
                         bucket, self._mesh),
                 )
+                if m.precompiled is not None:
+                    x = np.zeros((bucket, *m.input_shape), m.input_dtype)
+                    xd = jax.device_put(
+                        x, data_sharding(self._mesh, x.ndim))
+                    jax.device_get(runner(xd))
         self.warmup_s = round(time.perf_counter() - t0, 3)
 
     # -- client surface --------------------------------------------------
@@ -260,11 +281,18 @@ class InferenceEngine:
         otherwise. Crash/restart counts ride along so a probe can tell
         self-healed from never-faulted."""
         recovering = self._recovering.is_set()
-        return {
+        out = {
             "status": "recovering" if recovering else "ok",
             "dispatcher_crashes": self.telemetry.dispatcher_crashes,
             "dispatcher_restarts": self.telemetry.dispatcher_restarts,
         }
+        if recovering:
+            # when to re-probe: the rest of the backoff window — the
+            # /healthz 503 carries it as Retry-After so load balancers
+            # re-probe on schedule instead of hammering or forgetting
+            out["retry_after_s"] = round(
+                max(0.05, self._recover_until - time.monotonic()), 3)
+        return out
 
     # pause/resume: used by drains and tests that need deterministic
     # queue buildup (backpressure, deadline expiry) without sleeping on
@@ -306,6 +334,7 @@ class InferenceEngine:
                     return
                 if time.monotonic() - t0 > self._backoff_reset_s:
                     backoff = self._restart_backoff_s
+                self._recover_until = time.monotonic() + backoff
                 self._recovering.set()
                 self._stop.wait(backoff)  # close() wakes this instantly
                 self._recovering.clear()
@@ -320,7 +349,10 @@ class InferenceEngine:
         rr = list(self._models)  # round-robin cursor over models
         while not self._stop.is_set():
             if self._paused.is_set():
-                time.sleep(0.002)
+                # stop-responsive pause poll (jaxlint JX113): a bare
+                # time.sleep here would hold close() hostage to the
+                # poll tick instead of waking on the stop event
+                self._stop.wait(0.002)
                 continue
             self._drain_inbound(
                 pending, block=not any(pending.values()))

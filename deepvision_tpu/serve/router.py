@@ -1,0 +1,893 @@
+"""SLO-aware fleet router: health-gated load balancing over N replicas.
+
+The front tier the ROADMAP's millions-of-users story needs (item 4, and
+the TPU-pod playbook of PAPERS.md 1909.09756/2204.06514): capacity AND
+availability come from a fleet of replicas, not one bigger worker. A
+:class:`FleetRouter` supervises N replicas (``serve/replica.py``:
+in-process engines for tests, ``serve.py`` child processes in
+production) and turns them into one serving surface with the properties
+a single engine cannot have:
+
+- **health-gated balancing** — requests go to the least-loaded READY
+  replica; a replica whose ``/healthz`` degrades (the PR 4 supervisor
+  recovery path) is DRAINED the moment the probe loop sees the 503 —
+  no fresh traffic routes into a restart window.
+- **failover with exactly-once results** — an attempt that dies with
+  the replica is retried on a different replica (bounded), and a slow
+  attempt is *hedged*: a duplicate launches after ``hedge_after_s`` and
+  the first response wins. Every request resolves its future exactly
+  once, no matter how many attempts raced for it.
+- **circuit breaker + error budget** — per-model rolling failure
+  windows: when a model's replicas keep failing, the breaker opens and
+  the router sheds fast (429 + ``Retry-After``) instead of queueing
+  doomed work; a half-open probe closes it once the model recovers.
+- **SLO-aware admission** — per-model p95 deadline budgets feed the
+  admission EWMA (``admission.AdmissionController``): a request that
+  would wait past its model's budget is shed at the door with an
+  honest retry hint, and the budget doubles as the default deadline.
+- **supervision + metric-driven autoscaling** — dead replicas are
+  respawned with capped stop-responsive backoff; an :class:`Autoscaler`
+  reads the obs-registry signals the probe loop publishes (fleet
+  queue-wait p95, shed rate, dispatcher crashes) and adds/drains
+  replicas inside ``[min_replicas, max_replicas]`` with hysteresis
+  (sustain counts + cooldown) so it never flaps.
+
+Chaos sites ``replica_kill`` / ``replica_slow``
+(``resilience/faults.py``) consult per routed attempt with monotonic
+occurrence counters, so router chaos tests replay bit-identically —
+and ``bench.py serve --sweep`` SIGKILLs a real child process at peak
+load to prove the error budget instead of claiming it.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    Future,
+    InvalidStateError,
+    ThreadPoolExecutor,
+    wait as futures_wait,
+)
+from dataclasses import dataclass
+from typing import Callable
+
+from deepvision_tpu.serve.admission import AdmissionController, ShedError
+from deepvision_tpu.serve.replica import ReplicaDeadError
+from deepvision_tpu.serve.telemetry import RouterTelemetry
+
+__all__ = [
+    "AutoscaleConfig",
+    "Autoscaler",
+    "CircuitBreaker",
+    "CircuitConfig",
+    "FleetRouter",
+    "RouterShedError",
+]
+
+# replica slot states
+STARTING = "starting"
+READY = "ready"
+DRAINING = "draining"   # health-gated: probe saw a non-ok status
+RETIRING = "retiring"   # autoscale-down: drain then stop
+DEAD = "dead"
+STOPPED = "stopped"
+
+
+class RouterShedError(ShedError):
+    """Router-originated shed (circuit open / no READY replica). Same
+    ``retry_after_s`` contract as the admission :class:`ShedError`, so
+    both CLI surfaces emit the identical 429 + ``Retry-After`` hint."""
+
+
+# ------------------------------------------------------ circuit breaker
+
+
+@dataclass
+class CircuitConfig:
+    """Per-model rolling error budget. The breaker trips OPEN when, over
+    the last ``window`` attempts (and at least ``min_volume`` of them),
+    the failure fraction exceeds ``failure_frac``; it stays open for
+    ``open_s``, then HALF_OPEN admits one probe request — success
+    closes, failure re-opens."""
+
+    window: int = 32
+    min_volume: int = 8
+    failure_frac: float = 0.5
+    open_s: float = 2.0
+
+
+class CircuitBreaker:
+    """closed -> open -> half-open -> closed, per model."""
+
+    def __init__(self, cfg: CircuitConfig | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or CircuitConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._outcomes: list[bool] = []  # rolling window, True = failure
+        self.state = "closed"
+        self._open_until = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
+
+    def allow(self) -> bool:
+        """May a request proceed right now? HALF_OPEN admits one probe
+        at a time; a probe whose outcome never lands (shed before any
+        replica attempt) expires after ``open_s`` so the slot cannot
+        leak the breaker permanently open."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            now = self._clock()
+            if self.state == "open":
+                if now < self._open_until:
+                    return False
+                self.state = "half_open"
+                self._probe_inflight = False
+            # half_open: one probe in flight at a time (timed-out probes
+            # forfeit the slot)
+            if self._probe_inflight \
+                    and now - self._probe_started < self.cfg.open_s:
+                return False
+            self._probe_inflight = True
+            self._probe_started = now
+            return True
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            return round(max(0.05, self._open_until - self._clock()), 3)
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state == "half_open":
+                self.state = "closed"
+                self._outcomes.clear()
+                self._probe_inflight = False
+                return
+            self._push(False)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self.state == "half_open":
+                self._trip()
+                return
+            self._push(True)
+            n = len(self._outcomes)
+            if n >= self.cfg.min_volume and (
+                    sum(self._outcomes) / n) > self.cfg.failure_frac:
+                self._trip()
+
+    def _push(self, failed: bool) -> None:
+        self._outcomes.append(failed)
+        if len(self._outcomes) > self.cfg.window:
+            del self._outcomes[0]
+
+    def _trip(self) -> None:
+        self.state = "open"
+        self._open_until = self._clock() + self.cfg.open_s
+        self._outcomes.clear()
+        self._probe_inflight = False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state,
+                    "window_failures": sum(self._outcomes),
+                    "window_size": len(self._outcomes)}
+
+
+# ----------------------------------------------------------- autoscaler
+
+
+@dataclass
+class AutoscaleConfig:
+    """Hysteresis knobs for the metric-driven autoscaler. Pressure
+    (queue-wait p95 over ``up_queue_p95_ms``, shed rate over
+    ``up_shed_rate_per_s``, or fresh dispatcher crashes) must SUSTAIN
+    for ``sustain_up`` consecutive ticks to add a replica; calm must
+    sustain for ``sustain_down`` ticks to drain one; ``cooldown_s``
+    blocks back-to-back actions. ``down_queue_p95_ms`` sits well below
+    ``up_queue_p95_ms`` so the two thresholds can never chatter."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    interval_s: float = 1.0
+    up_queue_p95_ms: float = 200.0
+    up_shed_rate_per_s: float = 0.5
+    down_queue_p95_ms: float = 20.0
+    sustain_up: int = 2
+    sustain_down: int = 5
+    cooldown_s: float = 5.0
+
+
+class Autoscaler:
+    """Pure decision core (injectable clock): ``tick()`` maps one
+    signal sample to a new replica target. Kept free of fleet plumbing
+    so hysteresis is unit-testable without replicas or wall time."""
+
+    def __init__(self, cfg: AutoscaleConfig | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or AutoscaleConfig()
+        self._clock = clock
+        self._pressure_ticks = 0
+        self._calm_ticks = 0
+        self._last_action_t = -float("inf")
+        self._last_crashes = 0.0
+
+    def tick(self, *, queue_p95_ms: float, shed_rate_per_s: float,
+             dispatcher_crashes: float, target: int,
+             now: float | None = None) -> int:
+        cfg = self.cfg
+        now = self._clock() if now is None else now
+        crashed = dispatcher_crashes > self._last_crashes
+        self._last_crashes = max(self._last_crashes, dispatcher_crashes)
+        pressure = (queue_p95_ms > cfg.up_queue_p95_ms
+                    or shed_rate_per_s > cfg.up_shed_rate_per_s
+                    or crashed)
+        calm = (not pressure and shed_rate_per_s == 0.0
+                and queue_p95_ms < cfg.down_queue_p95_ms)
+        self._pressure_ticks = self._pressure_ticks + 1 if pressure else 0
+        self._calm_ticks = self._calm_ticks + 1 if calm else 0
+        in_cooldown = now - self._last_action_t < cfg.cooldown_s
+        if (pressure and self._pressure_ticks >= cfg.sustain_up
+                and target < cfg.max_replicas and not in_cooldown):
+            self._last_action_t = now
+            self._pressure_ticks = 0
+            return target + 1
+        if (calm and self._calm_ticks >= cfg.sustain_down
+                and target > cfg.min_replicas and not in_cooldown):
+            self._last_action_t = now
+            self._calm_ticks = 0
+            return target - 1
+        return target
+
+
+# ---------------------------------------------------------- fleet router
+
+
+class _Slot:
+    """One supervised replica position in the fleet."""
+
+    __slots__ = ("sid", "replica", "state", "inflight", "generation")
+
+    def __init__(self, sid: str, replica, state: str, generation: int):
+        self.sid = sid
+        self.replica = replica
+        self.state = state
+        self.inflight = 0
+        self.generation = generation
+
+
+class _Request:
+    """One routed request: resolve-once future + routing context."""
+
+    __slots__ = ("model", "key", "x", "future", "t_submit", "deadline",
+                 "_resolved", "_lock")
+
+    def __init__(self, model: str | None, x, deadline: float,
+                 key: str | None = None):
+        self.model = model
+        self.key = key if key is not None else (model or "_default")
+        self.x = x
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline
+        self._resolved = False
+        self._lock = threading.Lock()
+
+    def resolve(self, result=None, error: BaseException | None = None
+                ) -> bool:
+        """Exactly-once: True for the attempt that won, False for every
+        late hedge/duplicate — the 'no duplicate responses' guarantee."""
+        with self._lock:
+            if self._resolved:
+                return False
+            self._resolved = True
+        try:
+            if error is not None:
+                self.future.set_exception(error)
+            else:
+                self.future.set_result(result)
+        except InvalidStateError:  # client cancelled; nothing to deliver
+            pass
+        return True
+
+
+class FleetRouter:
+    """Route requests across a supervised fleet of replicas.
+
+    ``replica_factory(sid)`` builds (but does not start) a fresh replica
+    for slot id ``sid`` — the router starts it, probes it, and respawns
+    through the same factory after a death. ``slo`` maps model name ->
+    p95 deadline budget in seconds: it becomes both the model's default
+    request deadline and its admission budget (see
+    ``AdmissionController.slo_budget_s``).
+    """
+
+    def __init__(
+        self,
+        replica_factory: Callable[[str], object],
+        *,
+        replicas: int = 2,
+        models: list[str] | None = None,
+        slo: dict[str, float] | None = None,
+        default_deadline_s: float = 30.0,
+        max_queue: int = 256,
+        per_model_limit: int | None = None,
+        probe_interval_s: float = 0.25,
+        max_retries: int = 2,
+        hedge_after_s: float | None = None,
+        restart_backoff_s: float = 0.2,
+        restart_backoff_max_s: float = 10.0,
+        circuit: CircuitConfig | None = None,
+        autoscale: AutoscaleConfig | None = None,
+        max_workers: int = 32,
+        fault_injector=None,
+        telemetry: RouterTelemetry | None = None,
+        start: bool = True,
+    ):
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self._factory = replica_factory
+        self._models = list(models or [])
+        self._slo = dict(slo or {})
+        self._default_deadline_s = default_deadline_s
+        self._probe_interval_s = probe_interval_s
+        self._max_retries = max_retries
+        self._hedge_after_s = hedge_after_s
+        self._restart_backoff_s = restart_backoff_s
+        self._restart_backoff_max_s = restart_backoff_max_s
+        self._circuit_cfg = circuit or CircuitConfig()
+        self._autoscale_cfg = autoscale
+        self.telemetry = telemetry if telemetry is not None \
+            else RouterTelemetry()
+        self._admission = AdmissionController(
+            max_queue=max_queue, per_model_limit=per_model_limit,
+            slo_budget_s=self._slo or None)
+        self._injector = fault_injector
+        self._lock = threading.Lock()
+        self._slots: list[_Slot] = []
+        self._gen = 0
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._stop = threading.Event()
+        self._respawners: list[threading.Thread] = []
+        self._backoff = restart_backoff_s
+        self._target = replicas
+        if autoscale is not None:
+            self._target = max(autoscale.min_replicas,
+                               min(replicas, autoscale.max_replicas))
+            self._autoscaler = Autoscaler(autoscale)
+        else:
+            self._autoscaler = None
+        self._last_shed_totals = 0.0
+        self._last_signal_t = time.monotonic()
+        self._autoscale_due = time.monotonic()
+        self._respawn_not_before = 0.0
+        # TWO pools: coordinators (one per in-flight request) and
+        # replica attempts (<= 2 per RUNNING coordinator, so 2x workers
+        # can never starve) — one shared pool would deadlock the moment
+        # every worker held a coordinator waiting on a queued attempt
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="router-dispatch")
+        self._attempt_pool = ThreadPoolExecutor(
+            max_workers=2 * max_workers,
+            thread_name_prefix="router-attempt")
+        self.telemetry.replicas_target.set(self._target)
+        if start:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        """Start the initial fleet (replicas boot in parallel) and the
+        probe/supervisor thread. Raises if NO replica comes up."""
+        threads = [self._spawn_slot_async() for _ in range(self._target)]
+        for t in threads:
+            t.join()
+        if not self._ready_slots():
+            self.close()
+            raise RuntimeError("no replica became ready at startup")
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="router-probe", daemon=True)
+        self._probe_thread.start()
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Graceful: stop probing, let in-flight dispatches finish
+        (replicas stay up until the pool drains), then stop replicas.
+        Idempotent."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        t = getattr(self, "_probe_thread", None)
+        if t is not None:
+            t.join(timeout)
+        self._pool.shutdown(wait=True)
+        self._attempt_pool.shutdown(wait=True)
+        for th in list(self._respawners):
+            th.join(timeout)
+        with self._lock:
+            slots = list(self._slots)
+        stoppers = []
+        for s in slots:
+            th = threading.Thread(target=self._stop_replica, args=(s,),
+                                  name=f"router-stop-{s.sid}")
+            th.start()
+            stoppers.append(th)
+        for th in stoppers:
+            th.join(timeout)
+
+    @staticmethod
+    def _stop_replica(slot: _Slot) -> None:
+        try:
+            slot.replica.stop()
+        except Exception:
+            pass
+        slot.state = STOPPED
+
+    @staticmethod
+    def _kill_replica(slot: _Slot) -> None:
+        try:
+            slot.replica.kill()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- client surface --------------------------------------------------
+    @property
+    def buckets(self) -> tuple[int, ...]:
+        """Pipelining-window hint for the stdin-JSONL surface (the
+        fleet analog of the engine's bucket ladder)."""
+        return (64,)
+
+    def submit(self, x, model: str | None = None, *,
+               timeout_s: float | None = None) -> Future:
+        """Route one example; returns a Future resolving to the task's
+        result dict. Sheds raise immediately (circuit open / admission),
+        the same :class:`ShedError` contract as the engine."""
+        if self._stop.is_set():
+            raise RuntimeError("router is closed")
+        # anonymous requests on a single-model fleet resolve to that
+        # model for SLO/admission/breaker accounting (replicas still
+        # receive model=None and apply their own default)
+        key = model
+        if key is None:
+            key = self._models[0] if len(self._models) == 1 else "_default"
+        breaker = self._breaker(key)
+        if not breaker.allow():
+            self.telemetry.inc("shed_circuit")
+            raise RouterShedError(
+                f"circuit open for model {key!r} (replicas failing); "
+                "shedding fast", breaker.retry_after_s())
+        try:
+            self._admission.admit(key)
+        except ShedError:
+            self.telemetry.inc("shed_admission")
+            raise
+        self.telemetry.inc("requests")
+        # the model's p95 SLO budget is a deadline CEILING: it applies
+        # even under the CLI surfaces' blanket timeout (which would
+        # otherwise override it); an explicit tighter client timeout
+        # still wins
+        bounds = [b for b in (timeout_s, self._slo.get(key))
+                  if b is not None]
+        budget = min(bounds) if bounds else self._default_deadline_s
+        req = _Request(model, x, deadline=time.monotonic() + budget,
+                       key=key)
+        self._pool.submit(self._dispatch, req, breaker, key)
+        return req.future
+
+    # -- request lifecycle -----------------------------------------------
+    def _breaker(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = self._breakers[key] = CircuitBreaker(self._circuit_cfg)
+            return b
+
+    def _finish(self, req: _Request, key: str, *, result=None,
+                error: BaseException | None = None) -> bool:
+        """Resolve + bookkeep exactly once; -> whether THIS call won
+        the resolve race (late hedges/duplicates get False)."""
+        if not req.resolve(result, error):
+            return False
+        self._admission.release(key)
+        if error is None:
+            e2e = time.perf_counter() - req.t_submit
+            self.telemetry.record_completed(e2e)
+        elif isinstance(error, RouterShedError):
+            self.telemetry.inc("shed_no_replica")
+        elif isinstance(error, ShedError):
+            # replica-side backpressure that survived the retry budget:
+            # capacity exists but is saturated — not an availability gap
+            self.telemetry.inc("shed_replica")
+        else:
+            self.telemetry.inc("failed")
+        return True
+
+    def _pick(self, tried: set[str]) -> _Slot | None:
+        """Least-inflight READY slot, preferring ones not yet tried for
+        this request; falls back to a tried slot only when nothing else
+        is available (retrying a shed on the same replica later beats
+        failing outright)."""
+        with self._lock:
+            ready = [s for s in self._slots if s.state == READY]
+            fresh = [s for s in ready if s.sid not in tried]
+            pool = fresh or ready
+            if not pool:
+                return None
+            slot = min(pool, key=lambda s: (s.inflight, s.sid))
+            slot.inflight += 1
+            return slot
+
+    def _dispatch(self, req: _Request, breaker: CircuitBreaker,
+                  key: str) -> None:
+        """Coordinate attempts for one request: launch, hedge on a slow
+        primary, fail over on errors — until one attempt wins, the
+        retry budget is spent, or the deadline passes."""
+        outstanding: dict[Future, _Slot] = {}
+        tried: set[str] = set()
+        retries_left = self._max_retries
+        hedges_left = 1 if self._hedge_after_s is not None else 0
+        last_exc: BaseException | None = None
+        failed_over = False
+        try:
+            while True:
+                remaining = req.deadline - time.monotonic()
+                if remaining <= 0:
+                    self._finish(req, key, error=last_exc or TimeoutError(
+                        "deadline expired before any replica answered"))
+                    return
+                if not outstanding:
+                    slot = self._pick(tried)
+                    if slot is None:
+                        self._finish(req, key, error=(
+                            last_exc if isinstance(last_exc, ShedError)
+                            else RouterShedError(
+                                "no replica available (all draining, "
+                                "dead, or starting)",
+                                round(2 * self._probe_interval_s, 3))))
+                        return
+                    if failed_over:
+                        self.telemetry.inc("failovers")
+                        failed_over = False
+                    tried.add(slot.sid)
+                    outstanding[self._attempt_pool.submit(
+                        self._attempt, req, slot, breaker)] = slot
+                hedge_ok = (hedges_left > 0 and len(outstanding) == 1
+                            and len(tried) < self._slot_count())
+                timeout = (min(remaining, self._hedge_after_s)
+                           if hedge_ok else remaining)
+                done, _pending = futures_wait(
+                    set(outstanding), timeout=timeout,
+                    return_when=FIRST_COMPLETED)
+                if not done:
+                    if hedge_ok:
+                        slot = self._pick(tried)
+                        if slot is not None:
+                            hedges_left -= 1
+                            self.telemetry.inc("hedges")
+                            tried.add(slot.sid)
+                            outstanding[self._attempt_pool.submit(
+                                self._attempt, req, slot, breaker,
+                                hedge=True)] = slot
+                        else:
+                            hedges_left = 0
+                    continue
+                for f in done:
+                    outstanding.pop(f)
+                    ok, payload = f.result()
+                    if ok:
+                        self._finish(req, key, result=payload)
+                        return
+                    last_exc = payload
+                    if isinstance(payload, ReplicaDeadError):
+                        failed_over = True  # counted when a retry launches
+                if outstanding:
+                    continue  # a hedge is still racing
+                if isinstance(last_exc, ValueError) \
+                        or retries_left <= 0:
+                    # client errors never retry; budget exhausted fails
+                    self._finish(req, key, error=last_exc)
+                    return
+                retries_left -= 1
+        except Exception as e:  # coordinator bug: never strand the client
+            self._finish(req, key, error=e)
+
+    def _slot_count(self) -> int:
+        with self._lock:
+            return len([s for s in self._slots
+                        if s.state in (READY, DRAINING)])
+
+    def _attempt(self, req: _Request, slot: _Slot,
+                 breaker: CircuitBreaker, hedge: bool = False):
+        """One replica round-trip -> (ok, result_or_exc). Failure
+        bookkeeping (breaker, dead-replica handling) happens here so a
+        racing hedge's outcome is never lost."""
+        t0 = time.perf_counter()
+        try:
+            if req.future.done():
+                return False, RuntimeError("request already resolved")
+            if self._injector is not None:
+                delay = self._injector.check_replica_slow()
+                if delay:
+                    self._stop.wait(delay)
+                if self._injector.check_replica_kill():
+                    slot.replica.kill()
+            remaining = req.deadline - time.monotonic()
+            if remaining <= 0:
+                return False, TimeoutError("deadline expired")
+            result = slot.replica.request(
+                req.model, req.x, timeout_s=remaining)
+        except ReplicaDeadError as e:
+            breaker.record_failure()
+            self._on_replica_dead(slot, str(e))
+            return False, e
+        except ShedError as e:
+            return False, e  # overload is not a breaker failure
+        except ValueError as e:
+            return False, e  # client error: no breaker, no retry
+        except TimeoutError as e:
+            breaker.record_failure()
+            return False, e
+        except Exception as e:
+            breaker.record_failure()
+            return False, e
+        else:
+            breaker.record_success()
+            dt = time.perf_counter() - t0
+            self.telemetry.record_attempt(dt)
+            # the admission EWMA wants per-row SERVICE time (its shed
+            # estimate is depth x row_s): feed the replica round-trip,
+            # not the request's e2e — e2e already contains queue wait,
+            # and depth x e2e double-counts it into a shed spiral
+            self._admission.observe_batch(dt, 1)
+            if hedge and self._finish(req, req.key, result=result):
+                # the duplicate beat the primary: first response wins
+                # (one resolve, one set of bookkeeping — _finish's)
+                self.telemetry.inc("hedge_wins")
+            return True, result
+        finally:
+            with self._lock:
+                slot.inflight = max(0, slot.inflight - 1)
+
+    # -- supervision -----------------------------------------------------
+    def _on_replica_dead(self, slot: _Slot, why: str) -> None:
+        with self._lock:
+            if slot.state in (DEAD, STOPPED):
+                return
+            slot.state = DEAD
+        self.telemetry.inc("replica_deaths")
+        print(f"[router] replica {slot.sid} dead: {why}", file=sys.stderr, flush=True)
+
+    def _spawn_slot_async(self, generation: int | None = None
+                          ) -> threading.Thread:
+        """Start a fresh replica in a background thread (process
+        replicas take seconds to boot — never block routing on one)."""
+        with self._lock:
+            self._gen += 1
+            gen = self._gen if generation is None else generation
+            sid = f"r{gen}"
+            slot = _Slot(sid, None, STARTING, gen)
+            self._slots.append(slot)
+
+        def boot():
+            try:
+                replica = self._factory(sid)
+                slot.replica = replica
+                replica.start()
+            except Exception as e:
+                print(f"[router] replica {sid} failed to start: {e}",
+                      file=sys.stderr, flush=True)
+                slot.state = DEAD
+                return
+            # a boot finishing during close() still lands in _slots, so
+            # close()'s stop sweep shuts it down right after
+            slot.state = READY
+
+        t = threading.Thread(target=boot, name=f"router-boot-{sid}")
+        t.start()
+        self._respawners.append(t)
+        return t
+
+    def _ready_slots(self) -> list[_Slot]:
+        with self._lock:
+            return [s for s in self._slots if s.state == READY]
+
+    def _probe_loop(self) -> None:
+        """Health-gate + supervise + autoscale, every probe interval.
+        Sleeps through the stop event (jaxlint JX113: loop waits must
+        stay stop-responsive) so close() never blocks on a tick."""
+        while not self._stop.wait(self._probe_interval_s):
+            self._probe_once()
+            self._reap_and_respawn()
+            self._publish_signals_and_autoscale()
+            self._gc_respawners()
+
+    def _probe_once(self) -> None:
+        with self._lock:
+            slots = [s for s in self._slots
+                     if s.state in (READY, DRAINING, RETIRING)]
+        for slot in slots:
+            try:
+                health = slot.replica.probe()
+            except ReplicaDeadError as e:
+                self._on_replica_dead(slot, str(e))
+                continue
+            except Exception as e:
+                self._on_replica_dead(slot, f"probe error: {e}")
+                continue
+            ok = health.get("status") == "ok"
+            if slot.state == RETIRING:
+                if slot.inflight == 0:
+                    self._retire(slot)
+            elif ok and slot.state == DRAINING:
+                slot.state = READY
+                print(f"[router] replica {slot.sid} healthy again; "
+                      "undrained", file=sys.stderr, flush=True)
+            elif not ok and slot.state == READY:
+                slot.state = DRAINING
+                print(f"[router] replica {slot.sid} degraded "
+                      f"({health.get('status')}); draining", file=sys.stderr, flush=True)
+
+    def _retire(self, slot: _Slot) -> None:
+        th = threading.Thread(target=self._stop_replica, args=(slot,),
+                              name=f"router-retire-{slot.sid}")
+        th.start()
+        self._respawners.append(th)
+        with self._lock:
+            if slot in self._slots:
+                self._slots.remove(slot)
+        self.telemetry.inc("scale_downs")
+        print(f"[router] replica {slot.sid} drained and retired "
+              f"(target {self._target})", file=sys.stderr, flush=True)
+
+    def _reap_and_respawn(self) -> None:
+        """Respawn toward the target count with capped backoff between
+        waves. The backoff is a timestamp gate, never a sleep — the
+        probe loop must keep health-gating the survivors while a
+        crash-looping replica waits out its window."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [s for s in self._slots if s.state == DEAD]
+            for s in dead:
+                self._slots.remove(s)
+            alive = len([s for s in self._slots
+                         if s.state in (READY, DRAINING, STARTING)])
+            missing = self._target - alive
+        for s in dead:
+            # make sure the corpse is actually dead before forgetting
+            # it: a false death verdict (probe timeout under load) on a
+            # still-running child would otherwise leak a zombie process
+            # competing with its own replacement forever. kill() is
+            # idempotent and a no-op on an already-gone process.
+            t = threading.Thread(target=self._kill_replica, args=(s,),
+                                 name=f"router-reap-{s.sid}")
+            t.start()
+            self._respawners.append(t)
+        if dead:
+            # fresh deaths push the next respawn wave out and escalate
+            self._respawn_not_before = max(self._respawn_not_before,
+                                           now + self._backoff)
+            self._backoff = min(self._backoff * 2,
+                                self._restart_backoff_max_s)
+        if missing <= 0:
+            if not dead:
+                self._backoff = self._restart_backoff_s  # healthy: reset
+            return
+        if now < self._respawn_not_before:
+            return
+        for _ in range(missing):
+            self.telemetry.inc("replica_restarts")
+            self._spawn_slot_async()
+
+    def _gc_respawners(self) -> None:
+        self._respawners = [t for t in self._respawners if t.is_alive()]
+
+    # -- signals + autoscaling -------------------------------------------
+    def _publish_signals_and_autoscale(self) -> None:
+        now = time.monotonic()
+        tel = self.telemetry
+        with self._lock:
+            slots = [s for s in self._slots
+                     if s.state in (READY, DRAINING)]
+            ready_n = len([s for s in slots if s.state == READY])
+        queue_p95 = 0.0
+        sheds = float(tel.shed_admission + tel.shed_circuit
+                      + tel.shed_no_replica)
+        crashes = 0.0
+        for slot in slots:
+            try:
+                st = slot.replica.stats()
+            except Exception:
+                continue
+            t = st.get("telemetry", {})
+            queue_p95 = max(queue_p95,
+                            t.get("queue_wait", {}).get("p95_ms", 0.0))
+            sheds += float(t.get("shed", 0))
+            crashes += float(t.get("dispatcher_crashes", 0))
+        dt = max(1e-6, now - self._last_signal_t)
+        shed_rate = max(0.0, sheds - self._last_shed_totals) / dt
+        self._last_shed_totals = sheds
+        self._last_signal_t = now
+        tel.replicas_ready.set(ready_n)
+        tel.replicas_target.set(self._target)
+        tel.queue_wait_p95_ms.set(queue_p95)
+        tel.shed_rate_per_s.set(shed_rate)
+        tel.dispatcher_crashes.set(crashes)
+        if self._autoscaler is None or now < self._autoscale_due:
+            return
+        self._autoscale_due = now + self._autoscale_cfg.interval_s
+        # the autoscaler reads the published obs-registry signals BY
+        # NAME — the same numbers a human sees on GET /metrics
+        reg = tel.registry
+        new_target = self._autoscaler.tick(
+            queue_p95_ms=reg.value_of("router_queue_wait_p95_ms"),
+            shed_rate_per_s=reg.value_of("router_shed_rate_per_s"),
+            dispatcher_crashes=reg.value_of("router_dispatcher_crashes"),
+            target=self._target)
+        if new_target > self._target:
+            self._target = new_target
+            tel.inc("scale_ups")
+            print(f"[router] autoscale up -> {new_target} "
+                  f"(queue_p95={queue_p95:.1f}ms "
+                  f"shed_rate={shed_rate:.2f}/s)", file=sys.stderr, flush=True)
+            self._spawn_slot_async()
+        elif new_target < self._target:
+            self._target = new_target
+            with self._lock:
+                ready = [s for s in self._slots if s.state == READY]
+                victim = (min(ready, key=lambda s: (s.inflight, s.sid))
+                          if len(ready) > 1 else None)
+                if victim is not None:
+                    victim.state = RETIRING
+            if victim is None:
+                self._target = new_target + 1  # nothing safely drainable
+            else:
+                print(f"[router] autoscale down -> {new_target} "
+                      f"(draining {victim.sid})", file=sys.stderr, flush=True)
+        tel.replicas_target.set(self._target)
+
+    # -- introspection ---------------------------------------------------
+    def health(self) -> dict:
+        """Fleet liveness for ``/healthz``: ok while >= 1 replica is
+        READY; 503 (with a re-probe hint) while the whole fleet is
+        down/draining — the same contract a replica's own /healthz has,
+        one level up."""
+        ready = len(self._ready_slots())
+        status = "ok" if ready > 0 else "recovering"
+        out = {
+            "status": status,
+            "replicas_ready": ready,
+            "replicas_target": self._target,
+        }
+        if status != "ok":
+            out["retry_after_s"] = round(2 * self._probe_interval_s, 3)
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            replicas = [{
+                "id": s.sid,
+                "state": s.state,
+                "inflight": s.inflight,
+            } for s in self._slots]
+        return {
+            "models": sorted(self._models),
+            "replicas": replicas,
+            "target_replicas": self._target,
+            "slo_budgets_s": dict(self._slo),
+            "queue": self._admission.stats(),
+            "breakers": {k: b.snapshot()
+                         for k, b in self._breakers.items()},
+            "health": self.health(),
+            "telemetry": self.telemetry.snapshot(),
+        }
+
+    def summary_line(self) -> str:
+        return self.telemetry.summary_line()

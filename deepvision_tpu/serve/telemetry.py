@@ -33,7 +33,7 @@ from deepvision_tpu.obs.metrics import (
     default_registry,
 )
 
-__all__ = ["LatencyStats", "ServeTelemetry"]
+__all__ = ["LatencyStats", "ServeTelemetry", "RouterTelemetry"]
 
 
 class LatencyStats:
@@ -182,5 +182,107 @@ class ServeTelemetry:
 # counter field instead of ten hand-rolled copies
 for _f in _COUNTER_FIELDS:
     setattr(ServeTelemetry, _f,
+            property(lambda self, _f=_f: self._c[_f].value))
+del _f
+
+
+# router counters, in /stats JSON order. Sheds split by origin: an
+# admission shed means the FLEET is saturated (autoscale signal), a
+# circuit shed means a model's replicas keep FAILING (fast-fail), and a
+# no-replica shed means every replica is draining/dead (availability
+# gap the supervisor is already closing).
+_ROUTER_COUNTER_FIELDS = (
+    "requests",           # admitted into the router
+    "completed",          # futures resolved with a result
+    "failed",             # resolved with a non-shed error
+    "failovers",          # attempts retried on another replica after a
+                          # replica death/failure
+    "hedges",             # duplicate attempts launched on a slow primary
+    "hedge_wins",         # requests whose hedge resolved first
+    "shed_admission",     # router admission (queue/SLO budget) rejects
+    "shed_circuit",       # per-model circuit breaker open
+    "shed_no_replica",    # no READY replica to route to
+    "shed_replica",       # replica-side backpressure that survived the
+                          # retry budget (capacity saturated, not absent)
+    "replica_deaths",     # replicas observed dead (probe or attempt)
+    "replica_restarts",   # replicas respawned by the supervisor
+    "scale_ups",          # autoscaler added a replica
+    "scale_downs",        # autoscaler drained a replica
+)
+
+
+class RouterTelemetry:
+    """Counters + latency histograms + autoscaler-signal gauges for one
+    fleet router, registered under ``router_*`` names (default: the
+    process registry, so ``GET /metrics`` and the bench ``obs`` block
+    carry the fleet view). The gauges are the obs-registry signals the
+    metric-driven autoscaler consumes: fleet queue-wait p95, fleet shed
+    rate, and cumulative dispatcher crashes aggregated from the
+    replicas' own ``/stats``.
+
+    One router per process is the production shape and gets the default
+    registry (so ``GET /metrics`` carries the fleet); a SECOND router
+    in the same process must bring its own ``registry=`` — like the
+    ``serve_*`` names, registration is latest-wins, and two fleets
+    writing one ``router_*`` family would feed each other's autoscaler
+    (``bench.py serve --sweep`` isolates its side-by-side fleets this
+    way)."""
+
+    def __init__(self, registry: Registry | None = None):
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg  # the autoscaler reads its signals back here
+        self._lock = threading.Lock()
+        self._c = {f: reg.register(f"router_{f}", Counter())
+                   for f in _ROUTER_COUNTER_FIELDS}
+        self.e2e = LatencyStats(       # admitted -> future resolved
+            hist=reg.register("router_e2e_latency", Histogram()))
+        self.attempt = LatencyStats(   # one replica round-trip
+            hist=reg.register("router_attempt_latency", Histogram()))
+        # autoscaler signal gauges (written by the router's probe loop)
+        self.replicas_ready = reg.gauge("router_replicas_ready")
+        self.replicas_target = reg.gauge("router_replicas_target")
+        self.queue_wait_p95_ms = reg.gauge("router_queue_wait_p95_ms")
+        self.shed_rate_per_s = reg.gauge("router_shed_rate_per_s")
+        self.dispatcher_crashes = reg.gauge("router_dispatcher_crashes")
+
+    def inc(self, field: str, n: int = 1) -> None:
+        self._c[field].inc(n)
+
+    def record_attempt(self, seconds: float) -> None:
+        self.attempt.record(seconds)
+
+    def record_completed(self, e2e_s: float) -> None:
+        with self._lock:
+            self._c["completed"].inc()
+            self.e2e.record(e2e_s)
+
+    def snapshot(self) -> dict:
+        vals = {f: c.value for f, c in self._c.items()}
+        total_sheds = (vals["shed_admission"] + vals["shed_circuit"]
+                       + vals["shed_no_replica"] + vals["shed_replica"])
+        resolved = vals["completed"] + vals["failed"]
+        return {
+            **vals,
+            "sheds_total": total_sheds,
+            # the lived error budget: failed / resolved (sheds are the
+            # DESIGNED overload response, not budget burn)
+            "failed_frac": (round(vals["failed"] / resolved, 4)
+                            if resolved else 0.0),
+            "e2e_latency": self.e2e.summary(),
+            "attempt_latency": self.attempt.summary(),
+        }
+
+    def summary_line(self) -> str:
+        """Grep-stable one-liner for logs and the router smoke gate."""
+        v = self.snapshot()
+        return (f"[router] failovers={v['failovers']} "
+                f"hedges={v['hedges']} deaths={v['replica_deaths']} "
+                f"restarts={v['replica_restarts']} "
+                f"sheds={v['sheds_total']} completed={v['completed']} "
+                f"failed={v['failed']}")
+
+
+for _f in _ROUTER_COUNTER_FIELDS:
+    setattr(RouterTelemetry, _f,
             property(lambda self, _f=_f: self._c[_f].value))
 del _f

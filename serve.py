@@ -18,10 +18,23 @@ surfaces (``deepvision_tpu/serve/``):
     # serve a StableHLO artifact from predict.py export
     serve.py --artifact lenet5=lenet5.stablehlo
 
+    # serving FLEET: router front tier over N child-process replicas
+    # (health-gated balancing, failover, circuit breaker, autoscaling)
+    serve.py --fleet 2 -m lenet5 --http 8080
+    serve.py --fleet 2 --fleet-max 4 --slo lenet5=0.5 -m lenet5
+
 ``-m name[=workdir]`` is repeatable (multi-model host); every model's
 (bucket) executables compile at startup, so the first request is as
 fast as the thousandth. Saturation returns 429/shed responses with a
 ``retry_after`` hint instead of unbounded queueing.
+
+In ``--fleet N`` mode this process never touches jax: it spawns N
+copies of itself (``serve.py --http 0 --port-file ...``) as replicas
+and routes over them (``deepvision_tpu/serve/router.py``). ``--faults``
+then arms the ROUTER's chaos sites (``replica_kill`` / ``replica_slow``
+— a scheduled kill is a real SIGKILL), and the exit path prints the
+grep-stable ``[router] failovers=N ...`` line the router smoke gate
+asserts.
 """
 
 from __future__ import annotations
@@ -30,8 +43,11 @@ import argparse
 import json
 import sys
 from concurrent.futures import TimeoutError as _FutureTimeout
+from pathlib import Path
 
 import numpy as np
+
+from deepvision_tpu.serve.admission import ShedError
 
 
 def _parse_spec(spec: str) -> tuple[str, str | None]:
@@ -109,6 +125,61 @@ def _serving_mesh(buckets: tuple[int, ...]):
     return create_mesh(1, 1), buckets
 
 
+def build_fleet(args):
+    """Router front tier over ``args.fleet`` child-process replicas —
+    no jax in this process; each replica is this same CLI in
+    single-engine HTTP mode on an ephemeral port."""
+    from deepvision_tpu.serve.replica import ProcessReplica, replica_argv
+    from deepvision_tpu.serve.router import AutoscaleConfig, FleetRouter
+
+    if not (args.model or args.artifact):
+        sys.exit("no models: pass -m NAME[=WORKDIR] or --artifact")
+    child_argv = replica_argv(
+        args.model or [], artifact_specs=args.artifact or [],
+        buckets=args.buckets,
+        extra=(["--num-classes", str(args.num_classes)]
+               if args.num_classes is not None else [])
+        + ["--top", str(args.top), "--score", str(args.score),
+           "--max-queue", str(args.max_queue),
+           "--batch-window-ms", str(args.batch_window_ms),
+           "--timeout-s", str(args.timeout_s)])
+
+    def factory(sid: str):
+        return ProcessReplica(sid, child_argv)
+
+    injector = None
+    if args.faults:
+        from deepvision_tpu.resilience import FaultInjector
+
+        injector = FaultInjector(args.faults, seed=args.fault_seed)
+        print(f"fault injection armed (router sites): {args.faults!r}",
+              file=sys.stderr)
+    slo = {}
+    for spec in args.slo or []:
+        name, _, sec = spec.partition("=")
+        try:
+            slo[name] = float(sec)
+        except ValueError:
+            sys.exit(f"bad --slo spec {spec!r}; want NAME=SECONDS")
+    fleet_max = args.fleet_max or args.fleet
+    autoscale = None
+    if fleet_max > args.fleet:
+        autoscale = AutoscaleConfig(min_replicas=args.fleet,
+                                    max_replicas=fleet_max)
+    models = [(_parse_spec(s)[0]) for s in args.model or []]
+    print(f"starting fleet of {args.fleet} replica(s) "
+          f"({models or args.artifact}); replicas compile in "
+          "parallel...", file=sys.stderr)
+    router = FleetRouter(
+        factory, replicas=args.fleet, models=models, slo=slo or None,
+        default_deadline_s=args.timeout_s, max_queue=args.max_queue,
+        per_model_limit=args.per_model_limit, autoscale=autoscale,
+        hedge_after_s=args.hedge_after, fault_injector=injector,
+    )
+    print(f"fleet up: {router.health()}", file=sys.stderr)
+    return router
+
+
 def _jsonable(obj):
     if isinstance(obj, dict):
         return {k: _jsonable(v) for k, v in obj.items()}
@@ -132,15 +203,20 @@ def run_stdin(engine, args, stdin=None, stdout=None):
 
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
-    from deepvision_tpu.serve import ShedError
 
     pending: list[tuple[object, object, float]] = []  # (id, future, t0)
 
     def emit(rid, fut, t0):
         try:
-            result = fut.result(timeout=args.timeout_s)
+            result = fut.result(timeout=args.timeout_s + 1.0)
             line = {"id": rid, "result": _jsonable(result),
                     "ms": round((time.perf_counter() - t0) * 1e3, 2)}
+        except ShedError as e:
+            # async sheds (the router's circuit-open / all-replicas-
+            # draining path) carry the same retry hint a synchronous
+            # admission shed does
+            line = {"id": rid, "error": str(e),
+                    "retry_after": e.retry_after_s}
         except Exception as e:
             line = {"id": rid, "error": f"{type(e).__name__}: {e}"}
         print(json.dumps(line), file=stdout, flush=True)
@@ -197,6 +273,12 @@ def make_handler(engine, args):
     models = engine.stats()["models"]
 
     class Handler(http.server.BaseHTTPRequestHandler):
+        # HTTP/1.1: keep-alive connections, so a router/load-balancer
+        # client pays connection setup (and this server a handler
+        # thread) once per CLIENT, not once per request — every
+        # response path below sets Content-Length, which 1.1 requires
+        protocol_version = "HTTP/1.1"
+
         # quiet per-request logging; telemetry is the observability
         def log_message(self, *a):
             pass
@@ -221,10 +303,19 @@ def make_handler(engine, args):
             if self.path == "/healthz":
                 # degraded (503) while the dispatcher supervisor sits in
                 # a post-crash backoff: load balancers should drain this
-                # replica, not route fresh traffic into the restart
+                # replica, not route fresh traffic into the restart.
+                # The 503 carries Retry-After (rest of the backoff
+                # window) so balancers re-probe on schedule — the same
+                # hint contract the 429 shed path has always had.
                 h = engine.health()
                 h["models"] = models
-                self._send(200 if h["status"] == "ok" else 503, h)
+                if h["status"] == "ok":
+                    self._send(200, h)
+                else:
+                    import math
+
+                    ra = max(1, math.ceil(h.get("retry_after_s", 1.0)))
+                    self._send(503, h, {"Retry-After": str(ra)})
             elif self.path == "/stats":
                 # /stats reads through the obs-backed telemetry
                 # snapshot: every histogram's (count, total, samples)
@@ -249,14 +340,23 @@ def make_handler(engine, args):
                 req = json.loads(self.rfile.read(n))
                 if not isinstance(req, dict):
                     raise ValueError("request must be a JSON object")
-                x = np.asarray(req["input"], np.float32)
+                x = _decode_input(req)
+                # per-request deadline (the fleet router forwards its
+                # remaining budget here); the CLI blanket is a CEILING
+                timeout_s = args.timeout_s
+                if "timeout_s" in req:
+                    timeout_s = min(float(req["timeout_s"]),
+                                    args.timeout_s)
+                    if timeout_s <= 0:
+                        raise ValueError(
+                            f"timeout_s must be > 0, got {timeout_s}")
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
             try:
                 fut = engine.submit(x, model=req.get("model"),
-                                    timeout_s=args.timeout_s)
-                result = fut.result(timeout=args.timeout_s + 1.0)
+                                    timeout_s=timeout_s)
+                result = fut.result(timeout=timeout_s + 1.0)
             except ShedError as e:
                 self._send(429, {"error": str(e),
                                  "retry_after": e.retry_after_s},
@@ -268,12 +368,41 @@ def make_handler(engine, args):
             except (TimeoutError, _FutureTimeout) as e:
                 self._send(504, {"error": f"deadline expired: {e}"})
                 return
-            except (ValueError, RuntimeError) as e:
+            except ValueError as e:
                 self._send(400, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                # server-side failure (dispatcher crash, engine closed,
+                # exhausted fleet failover): 500, NOT 400 — a 400 tells
+                # clients (and the fleet router, which maps it to a
+                # non-retryable client error) never to retry, burying
+                # exactly the fault class failover exists to absorb
+                self._send(500, {"error": str(e)})
                 return
             self._send(200, {"result": _jsonable(result)})
 
     return Handler
+
+
+def _decode_input(req: dict) -> np.ndarray:
+    """Request payload -> input array. Two wire formats:
+
+    - ``"input"``: nested JSON float lists (human-typable, the
+      original format);
+    - ``"input_b64"`` + ``"shape"`` [+ ``"dtype"``, default float32]:
+      base64 of the raw little-endian array bytes. ~20x cheaper to
+      encode/decode than float lists on both ends — the format the
+      fleet router uses, where per-request JSON cost is fleet-wide
+      routing capacity.
+    """
+    if "input_b64" in req:
+        import base64
+
+        dtype = np.dtype(req.get("dtype", "float32"))
+        raw = base64.b64decode(req["input_b64"], validate=True)
+        x = np.frombuffer(raw, dtype=dtype).reshape(req["shape"])
+        return np.ascontiguousarray(x, np.float32)
+    return np.asarray(req["input"], np.float32)
 
 
 def _render_metrics() -> str:
@@ -288,12 +417,38 @@ def _render_metrics() -> str:
     return default_registry().render_prometheus()
 
 
-def run_http(engine, args):
+def _make_server(addr, handler):
+    """ThreadingHTTPServer tuned for fleet traffic: a deep accept
+    backlog (the default 5 drops SYNs under a router's connection
+    burst — each drop is a 1-3s TCP retransmit stall that reads as a
+    'slow replica'), and daemon handler threads so shutdown never
+    hangs on an idle keep-alive connection."""
     import http.server
 
-    server = http.server.ThreadingHTTPServer(
-        ("", args.http), make_handler(engine, args))
-    print(f"listening on :{args.http} "
+    srv = http.server.ThreadingHTTPServer(addr, handler,
+                                          bind_and_activate=False)
+    srv.request_queue_size = 128
+    srv.daemon_threads = True
+    srv.server_bind()
+    srv.server_activate()
+    return srv
+
+
+def run_http(engine, args):
+    server = _make_server(("", args.http), make_handler(engine, args))
+    port = server.server_address[1]
+    if getattr(args, "port_file", None):
+        # atomic write: a fleet router polls this file to find the
+        # ephemeral port (--http 0), and must never read a torn value
+        import os
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(
+            dir=str(Path(args.port_file).parent) or ".")
+        with os.fdopen(fd, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, args.port_file)
+    print(f"listening on :{port} "
           f"(POST /v1/predict, GET /stats, GET /metrics, GET /healthz)",
           file=sys.stderr)
     try:
@@ -311,7 +466,30 @@ def main(argv=None):
     p.add_argument("--artifact", action="append",
                    help="[NAME=]PATH to a StableHLO export, repeatable")
     p.add_argument("--http", type=int, default=None,
-                   help="HTTP port (default: stdin-JSONL mode)")
+                   help="HTTP port (default: stdin-JSONL mode); 0 binds "
+                        "an ephemeral port (see --port-file)")
+    p.add_argument("--port-file", default=None,
+                   help="write the actually-bound HTTP port here "
+                        "(atomic); how a fleet router finds its "
+                        "ephemeral-port replicas")
+    p.add_argument("--fleet", type=int, default=None,
+                   help="run a ROUTER over this many child-process "
+                        "replicas instead of one in-process engine")
+    p.add_argument("--fleet-max", type=int, default=None,
+                   help="autoscaler ceiling (default: --fleet, i.e. "
+                        "autoscaling off); the metric-driven autoscaler "
+                        "adds/drains replicas between --fleet and this")
+    p.add_argument("--slo", action="append",
+                   help="NAME=SECONDS per-model p95 deadline budget, "
+                        "repeatable; feeds SLO-aware admission and the "
+                        "default request deadline (fleet mode)")
+    p.add_argument("--hedge-after", type=float, default=None,
+                   help="fleet mode: launch a duplicate attempt on a "
+                        "second replica when the primary hasn't "
+                        "answered within this many seconds (first "
+                        "response wins, exactly once); off by default "
+                        "— hedging trades duplicate work for tail "
+                        "latency")
     p.add_argument("--buckets", default="1,4,16,64",
                    help="batch bucket ladder (comma-separated)")
     p.add_argument("--max-queue", type=int, default=256)
@@ -336,6 +514,21 @@ def main(argv=None):
                         "serving session into this directory (started "
                         "after warmup, stopped at shutdown)")
     args = p.parse_args(argv)
+
+    if args.fleet is not None:
+        # fleet mode: router over child processes, no jax in THIS
+        # process (the replicas compile; the router only routes)
+        router = build_fleet(args)
+        try:
+            if args.http is not None:
+                run_http(router, args)
+            else:
+                run_stdin(router, args)
+        finally:
+            router.close()
+            # grep-stable exit line: the router smoke gate asserts it
+            print(router.summary_line(), file=sys.stderr, flush=True)
+        return
 
     from deepvision_tpu.obs.profiler import profile_session
 

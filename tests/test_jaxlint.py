@@ -770,6 +770,86 @@ def test_load_config_reads_timed_funcs(tmp_path):
     assert "*_train_step" in LintConfig().timed_funcs
 
 
+# ----------------------------------------------------------- JX113
+
+
+def test_jx113_flags_stop_blind_sleep_in_service_loop(tmp_path):
+    r = lint(tmp_path, "lib/serve.py", """
+        import time
+        from time import sleep
+
+        def _supervise_loop(self):
+            backoff = 0.05
+            while not self._stop.is_set():
+                try:
+                    self._dispatch_once()
+                except Exception:
+                    time.sleep(backoff)       # shutdown hangs here
+                    backoff *= 2
+
+        def probe_replicas(slots):
+            for s in slots:
+                s.check()
+                sleep(0.25)                   # bare-name form
+        """)
+    assert codes(r) == ["JX113", "JX113"]
+    assert "stop event" in r.findings[0].message
+    assert "Event.wait" in r.findings[0].message
+
+
+def test_jx113_passes_event_wait_and_non_loop_functions(tmp_path):
+    r = lint(tmp_path, "lib/serve.py", """
+        import time
+
+        def _supervise_loop(self):
+            backoff = 0.05
+            while not self._stop.is_set():
+                self._stop.wait(backoff)      # stop-responsive: OK
+
+        def _rollback(self, pol):
+            # not a service loop (name doesn't match the knob), and
+            # not inside a loop anyway
+            time.sleep(pol.backoff(1))
+
+        def _dispatch_loop(self):
+            time.sleep(0.1)                   # matched name, but the
+            while not self._stop.is_set():    # sleep is OUTSIDE a loop
+                self._drain()
+        """)
+    assert codes(r) == []
+
+
+def test_jx113_loop_sleep_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(loop_sleep_funcs=["poll_*"])
+    r = lint(tmp_path, "lib/serve.py", """
+        import time
+
+        def poll_workers(stop):
+            while not stop.is_set():
+                time.sleep(0.5)               # matched by the knob
+
+        def _supervise_loop(self):
+            while not self._stop.is_set():
+                time.sleep(0.5)               # NOT matched now
+        """, cfg=cfg)
+    assert codes(r) == ["JX113"]
+
+
+def test_load_config_reads_loop_sleep_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        loop_sleep_funcs = ["poll_*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.loop_sleep_funcs == ["poll_*"]
+    # defaults cover the serve dispatcher/supervisor/router naming
+    assert "*dispatch*" in LintConfig().loop_sleep_funcs
+    assert "*probe*" in LintConfig().loop_sleep_funcs
+
+
 # ------------------------------------------- suppression + baseline
 
 

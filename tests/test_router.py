@@ -1,0 +1,768 @@
+"""Fleet router (deepvision_tpu/serve/router.py + replica.py):
+health-gated draining, failover with exactly-once results (no duplicate
+responses from hedged retries), circuit-breaker open/half-open/close,
+autoscaler hysteresis, SLO-budget admission, Retry-After propagation,
+and the replica_kill/replica_slow chaos sites at load.
+
+Router-logic tests run on scripted FakeReplicas (zero compile cost) or
+in-process EngineReplicas over the toy linear model (millisecond
+compiles), so the whole fleet matrix stays in the fast tier; the real
+child-process path (SIGKILL and all) is `test_process_replica_*` in the
+slow tier plus `make router-smoke` / `bench.py serve --sweep`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from deepvision_tpu.serve.replica import ReplicaDeadError  # noqa: E402
+from deepvision_tpu.serve.router import (  # noqa: E402
+    AutoscaleConfig,
+    Autoscaler,
+    CircuitBreaker,
+    CircuitConfig,
+    FleetRouter,
+    RouterShedError,
+)
+
+# ------------------------------------------------------------- fixtures
+
+
+def toy_model(name="toy", weight=2.0, dim=3):
+    import jax.numpy as jnp
+
+    from deepvision_tpu.serve import ServedModel
+
+    def forward(variables, x):
+        return {"y": x * variables["w"] + jnp.float32(0.5)}
+
+    def post(host, i):
+        return {"y": np.asarray(host["y"][i]).tolist()}
+
+    return ServedModel(
+        name=name, task="classify", forward=forward,
+        variables={"w": np.float32(weight)}, input_shape=(dim,),
+        postprocess=post,
+    )
+
+
+def expected_toy(x, weight=2.0):
+    return (np.asarray(x, np.float32) * np.float32(weight)
+            + np.float32(0.5)).tolist()
+
+
+def engine_factory(**engine_kw):
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.serve import EngineReplica
+
+    engine_kw.setdefault("mesh", create_mesh(1, 1))
+    engine_kw.setdefault("buckets", (1, 4))
+
+    def factory(sid: str):
+        return EngineReplica(sid, lambda: [toy_model()], **engine_kw)
+
+    return factory
+
+
+class FakeReplica:
+    """Scripted replica: deterministic health, latency, and failures —
+    the router's logic under test, not the engine's."""
+
+    def __init__(self, rid: str):
+        self.replica_id = rid
+        self.status = "ok"
+        self.delay_s = 0.0
+        self.queue_p95_ms = 0.0  # what stats() reports (autoscale signal)
+        self.requests: list = []
+        self.dead = False
+        self.stopped = False
+        self.die_on_request = False
+
+    def start(self):
+        pass
+
+    def stop(self):
+        self.stopped = True
+
+    def kill(self):
+        self.dead = True
+
+    def request(self, model, x, *, timeout_s=None):
+        if self.dead:
+            raise ReplicaDeadError(f"{self.replica_id}: dead")
+        self.requests.append((model, np.asarray(x).tolist()))
+        if self.die_on_request:
+            self.dead = True
+            raise ReplicaDeadError(f"{self.replica_id}: died mid-request")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return {"echo": np.asarray(x).tolist(), "by": self.replica_id}
+
+    def probe(self):
+        if self.dead:
+            raise ReplicaDeadError(f"{self.replica_id}: dead")
+        return {"status": self.status}
+
+    def stats(self):
+        return {"telemetry": {"queue_wait": {"p95_ms": self.queue_p95_ms},
+                              "shed": 0, "dispatcher_crashes": 0}}
+
+
+def fake_fleet(n=2, **router_kw):
+    """Router over scripted fakes; ``spawned`` records every replica
+    the factory ever produced (initial fleet + respawns)."""
+    spawned: list[FakeReplica] = []
+
+    def factory(sid: str):
+        r = FakeReplica(sid)
+        spawned.append(r)
+        return r
+
+    router_kw.setdefault("probe_interval_s", 0.03)
+    router = FleetRouter(factory, replicas=n, models=["toy"], **router_kw)
+    return router, spawned
+
+
+def wait_until(cond, timeout=20.0, interval=0.01, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------- routing + balancing
+
+
+def test_router_routes_and_results_are_correct():
+    from deepvision_tpu.serve import FleetRouter
+
+    router = FleetRouter(engine_factory(), replicas=2, models=["toy"],
+                         probe_interval_s=0.05)
+    try:
+        futs = [router.submit(np.full(3, i, np.float32), model="toy")
+                for i in range(12)]
+        for i, f in enumerate(futs):
+            assert f.result(timeout=30)["y"] == expected_toy(
+                np.full(3, i, np.float32))
+        snap = router.telemetry.snapshot()
+        assert snap["completed"] == 12
+        assert snap["failed"] == 0
+        assert snap["failed_frac"] == 0.0
+    finally:
+        router.close()
+
+
+def test_router_balances_load_across_replicas():
+    router, spawned = fake_fleet(2)
+    try:
+        # slow replies keep inflight counts honest, so least-inflight
+        # must spread a concurrent burst over BOTH replicas
+        for r in spawned:
+            r.delay_s = 0.05
+        futs = [router.submit(np.zeros(3, np.float32)) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=30)
+        assert len(spawned[0].requests) > 0
+        assert len(spawned[1].requests) > 0
+        assert len(spawned[0].requests) + len(spawned[1].requests) == 8
+    finally:
+        router.close()
+
+
+# ------------------------------------------------ health-gated drains
+
+
+def test_health_gated_draining_and_undraining():
+    router, spawned = fake_fleet(2)
+    try:
+        a, b = spawned[0], spawned[1]
+
+        def state_of(rid):
+            return {r["id"]: r["state"]
+                    for r in router.stats()["replicas"]}.get(rid)
+
+        # b degrades (the PR 4 /healthz 503 path): probe must drain it
+        b.status = "recovering"
+        wait_until(lambda: state_of(b.replica_id) == "draining",
+                   msg="replica drained on degraded health")
+        n_a = len(a.requests)
+        futs = [router.submit(np.zeros(3, np.float32)) for _ in range(6)]
+        for f in futs:
+            assert f.result(timeout=30)["by"] == a.replica_id
+        assert len(a.requests) == n_a + 6
+        # recovery: probe must route traffic back
+        b.status = "ok"
+        wait_until(lambda: state_of(b.replica_id) == "ready",
+                   msg="replica undrained on recovery")
+        b.delay_s = a.delay_s = 0.02
+        futs = [router.submit(np.zeros(3, np.float32)) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=30)
+        assert any(len(b.requests) > 0 for _ in [0])  # b serves again
+    finally:
+        router.close()
+
+
+def test_all_replicas_draining_sheds_with_retry_after():
+    router, spawned = fake_fleet(1)
+    try:
+        spawned[0].status = "recovering"
+        wait_until(lambda: router.health()["status"] == "recovering",
+                   msg="fleet degraded")
+        assert router.health()["retry_after_s"] > 0
+        fut = router.submit(np.zeros(3, np.float32))
+        with pytest.raises(RouterShedError) as exc:
+            fut.result(timeout=30)
+        assert exc.value.retry_after_s > 0
+        assert router.telemetry.shed_no_replica == 1
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------ failover
+
+
+def test_failover_exactly_once_no_duplicate_response():
+    router, spawned = fake_fleet(2)
+    try:
+        a, b = spawned[0], spawned[1]
+        a.die_on_request = True  # dies WITH the first request in flight
+        results = []
+        fut = router.submit(np.ones(3, np.float32))
+        fut.add_done_callback(lambda f: results.append(f.result()))
+        res = fut.result(timeout=30)
+        assert res["by"] == b.replica_id  # failed over, one response
+        time.sleep(0.2)  # a late duplicate would land in this window
+        assert results == [res]
+        tel = router.telemetry
+        assert tel.failovers == 1
+        assert tel.replica_deaths == 1
+        assert tel.completed == 1 and tel.failed == 0
+        # the dead replica is respawned toward the target
+        wait_until(lambda: len(router.health() and
+                               router._ready_slots()) == 2,
+                   msg="fleet healed to target")
+        assert tel.replica_restarts >= 1
+    finally:
+        router.close()
+
+
+def test_hedged_retry_first_response_wins_exactly_once():
+    router, spawned = fake_fleet(2, hedge_after_s=0.05)
+    try:
+        a, b = spawned[0], spawned[1]
+        a.delay_s = 0.6  # primary is slow, not dead
+        t0 = time.perf_counter()
+        res = router.submit(np.ones(3, np.float32)).result(timeout=30)
+        dt = time.perf_counter() - t0
+        assert res["by"] == b.replica_id       # the hedge won
+        assert dt < 0.5                        # did NOT wait out the slow primary
+        tel = router.telemetry
+        assert tel.hedges == 1
+        assert tel.hedge_wins == 1
+        assert tel.completed == 1              # exactly one resolution
+        # both replicas did the work (that IS hedging); one answer won
+        assert len(a.requests) == 1 and len(b.requests) == 1
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------ circuit breaker
+
+
+def test_circuit_breaker_open_half_open_close_unit():
+    t = [0.0]
+    cb = CircuitBreaker(CircuitConfig(window=8, min_volume=4,
+                                      failure_frac=0.5, open_s=2.0),
+                        clock=lambda: t[0])
+    for _ in range(4):
+        assert cb.allow()
+        cb.record_failure()
+    assert cb.state == "open"
+    assert not cb.allow()                 # fast-fail while open
+    assert cb.retry_after_s() > 0
+    t[0] = 2.1                            # cooldown elapsed
+    assert cb.allow()                     # half-open: one probe
+    assert cb.state == "half_open"
+    assert not cb.allow()                 # second probe refused
+    cb.record_failure()                   # probe failed -> re-open
+    assert cb.state == "open"
+    t[0] = 4.3
+    assert cb.allow()
+    cb.record_success()                   # probe succeeded -> closed
+    assert cb.state == "closed"
+    assert cb.allow()
+
+
+def test_circuit_half_open_probe_slot_expires():
+    """A half-open probe whose outcome never lands (e.g. shed before
+    any replica attempt) must not leak the breaker open forever."""
+    t = [0.0]
+    cb = CircuitBreaker(CircuitConfig(open_s=1.0), clock=lambda: t[0])
+    cb._trip()
+    t[0] = 1.1
+    assert cb.allow()            # probe #1, outcome never recorded
+    assert not cb.allow()
+    t[0] = 2.2                   # probe slot expired
+    assert cb.allow()
+
+
+def test_router_opens_circuit_and_sheds_fast():
+    router, spawned = fake_fleet(
+        2, max_retries=0,
+        circuit=CircuitConfig(window=8, min_volume=4, failure_frac=0.5,
+                              open_s=30.0))
+    try:
+        for r in spawned:
+            r.status = "ok"
+
+            def dying(model, x, timeout_s=None, _r=r):
+                raise RuntimeError("persistent replica failure")
+
+            r.request = dying
+        for _ in range(8):
+            try:
+                fut = router.submit(np.zeros(3, np.float32))
+            except RouterShedError:
+                break  # breaker opened mid-burst: the goal state
+            with pytest.raises(Exception):
+                fut.result(timeout=30)
+        # breaker open: submits now shed synchronously, fast, with a hint
+        with pytest.raises(RouterShedError) as exc:
+            router.submit(np.zeros(3, np.float32))
+        assert exc.value.retry_after_s > 0
+        assert router.stats()["breakers"]["toy"]["state"] == "open"
+        assert router.telemetry.shed_circuit >= 1
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------------- autoscaler
+
+
+def test_autoscaler_hysteresis_unit():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3, sustain_up=2,
+                          sustain_down=3, cooldown_s=10.0,
+                          up_queue_p95_ms=200.0, down_queue_p95_ms=20.0,
+                          up_shed_rate_per_s=0.5)
+    a = Autoscaler(cfg)
+    calm = dict(queue_p95_ms=5.0, shed_rate_per_s=0.0,
+                dispatcher_crashes=0.0)
+    hot = dict(queue_p95_ms=500.0, shed_rate_per_s=0.0,
+               dispatcher_crashes=0.0)
+    # one hot tick is NOT enough (sustain_up=2)
+    assert a.tick(**hot, target=1, now=0.0) == 1
+    assert a.tick(**hot, target=1, now=1.0) == 2       # sustained -> up
+    # cooldown blocks an immediate second action
+    assert a.tick(**hot, target=2, now=2.0) == 2
+    assert a.tick(**hot, target=2, now=3.0) == 2
+    # after cooldown, sustained pressure scales again, capped at max
+    assert a.tick(**hot, target=2, now=12.0) == 3
+    assert a.tick(**hot, target=3, now=30.0) == 3      # at max: hold
+    # middle ground (neither hot nor calm) never scales down
+    mid = dict(queue_p95_ms=100.0, shed_rate_per_s=0.0,
+               dispatcher_crashes=0.0)
+    for i in range(6):
+        assert a.tick(**mid, target=3, now=40.0 + i) == 3
+    # calm must SUSTAIN (sustain_down=3) before draining
+    assert a.tick(**calm, target=3, now=50.0) == 3
+    assert a.tick(**calm, target=3, now=51.0) == 3
+    assert a.tick(**calm, target=3, now=52.0) == 2     # sustained -> down
+    # a fresh crash is pressure even with a quiet queue
+    a2 = Autoscaler(cfg)
+    crash = dict(queue_p95_ms=0.0, shed_rate_per_s=0.0,
+                 dispatcher_crashes=1.0)
+    assert a2.tick(**crash, target=1, now=0.0) == 1
+    assert a2.tick(**dict(crash, dispatcher_crashes=2.0),
+                   target=1, now=1.0) == 2
+    # min/max are hard walls
+    assert a2.tick(**calm, target=1, now=100.0) == 1
+
+
+def test_router_autoscales_up_on_pressure_and_down_when_calm():
+    """Live wiring of the metric loop: replica /stats queue-wait p95 ->
+    probe-loop aggregation -> obs-registry gauges -> autoscaler tick ->
+    spawn/drain. The signal is driven through the replicas' own stats
+    surface (what a real engine reports), so the transition points are
+    deterministic instead of racing a load generator on a 2-core box."""
+    router, spawned = fake_fleet(
+        1, probe_interval_s=0.02,
+        autoscale=AutoscaleConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.05,
+            sustain_up=2, sustain_down=3, cooldown_s=0.2,
+            up_queue_p95_ms=200.0, down_queue_p95_ms=50.0))
+    try:
+        for r in spawned:
+            r.queue_p95_ms = 500.0  # sustained pressure
+        wait_until(lambda: len(router._ready_slots()) == 2,
+                   msg="autoscale up to 2 replicas")
+        assert router.telemetry.scale_ups >= 1
+        from deepvision_tpu.obs.metrics import default_registry
+
+        assert default_registry().value_of(
+            "router_queue_wait_p95_ms") == 500.0
+        # calm: pressure gone, fleet must drain back to min=1
+        for r in spawned:
+            r.queue_p95_ms = 0.0
+        wait_until(lambda: router.telemetry.scale_downs >= 1
+                   and len(router._ready_slots()) == 1,
+                   msg="autoscale down to 1 replica")
+        # and it holds at min (never drains below)
+        time.sleep(0.3)
+        assert len(router._ready_slots()) == 1
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------ SLO admission
+
+
+def test_slo_budget_feeds_admission_ewma():
+    from deepvision_tpu.serve import AdmissionController, ShedError
+
+    adm = AdmissionController(max_queue=64,
+                              slo_budget_s={"m": 0.010})
+    # teach the EWMA a 5ms/request service time
+    for _ in range(50):
+        adm.observe_batch(0.005, 1)
+    adm.admit("m")   # est wait 0 -> fine
+    adm.admit("m")   # est wait ~5ms < 10ms budget
+    adm.admit("m")
+    with pytest.raises(ShedError, match="budget"):
+        adm.admit("m")  # est wait ~15ms > 10ms budget: shed at the door
+    # un-budgeted models still admit on queue depth alone
+    adm.admit("other")
+    assert adm.stats()["slo_budget_s"] == {"m": 0.010}
+
+
+def test_router_slo_budget_sets_default_deadline_and_sheds():
+    router, spawned = fake_fleet(1, slo={"toy": 0.2}, max_retries=0)
+    try:
+        spawned[0].delay_s = 0.6  # slower than the model's p95 budget
+        fut = router.submit(np.zeros(3, np.float32), model="toy")
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=30)  # SLO budget = the default deadline
+        # the budget is a CEILING: the CLI surfaces' blanket timeout
+        # (30s default) must not override a 0.2s model SLO
+        t0 = time.perf_counter()
+        fut = router.submit(np.zeros(3, np.float32), model="toy",
+                            timeout_s=30.0)
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=30)
+        assert time.perf_counter() - t0 < 5.0
+        # ...while an explicit TIGHTER client timeout still wins
+        t0 = time.perf_counter()
+        fut = router.submit(np.zeros(3, np.float32), model="toy",
+                            timeout_s=0.05)
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=30)
+        assert time.perf_counter() - t0 < 0.5
+        assert router.stats()["slo_budgets_s"] == {"toy": 0.2}
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------- chaos sites
+
+
+def test_fault_sites_replay_bit_identically():
+    from deepvision_tpu.resilience import FaultInjector
+
+    def trace(inj):
+        out = []
+        for _ in range(6):
+            out.append((inj.check_replica_kill(),
+                        inj.check_replica_slow()))
+        return out
+
+    a = FaultInjector("replica_kill@2,replica_slow@4:0.2", seed=0)
+    b = FaultInjector("rkill@2,rslow@4:0.2", seed=0)  # aliases
+    ta, tb = trace(a), trace(b)
+    assert ta == tb  # deterministic, alias-identical replay
+    assert ta[2][0] is True and sum(k for k, _ in ta) == 1
+    assert ta[4][1] == 0.2 and [s for _, s in ta].count(None) == 5
+    assert a.summary() == "replica_kill@2 replica_slow@4"
+
+
+def test_replica_kill_chaos_error_budget_and_recovery():
+    """The fast-tier twin of the bench chaos drill: kill a replica at
+    occurrence 5 mid-stream — every request still answers (failover),
+    the failed-request budget stays at 0, and the fleet heals."""
+    from deepvision_tpu.resilience import FaultInjector
+    from deepvision_tpu.serve import FleetRouter
+
+    inj = FaultInjector("replica_kill@5")
+    router = FleetRouter(engine_factory(), replicas=2, models=["toy"],
+                         probe_interval_s=0.05, fault_injector=inj)
+    try:
+        lat = []
+        for i in range(40):
+            t0 = time.perf_counter()
+            res = router.submit(np.full(3, i, np.float32),
+                                model="toy").result(timeout=30)
+            lat.append(time.perf_counter() - t0)
+            assert res["y"] == expected_toy(np.full(3, i, np.float32))
+        tel = router.telemetry
+        assert tel.replica_deaths == 1
+        assert tel.failovers == 1
+        assert tel.completed == 40 and tel.failed == 0
+        snap = tel.snapshot()
+        assert snap["failed_frac"] <= 0.01  # the chaos error budget
+        # p95 recovered: post-kill tail latencies are service-sized
+        # again, not failover-sized
+        tail = sorted(lat[-10:])
+        assert tail[-1] < 5.0
+        wait_until(lambda: len(router._ready_slots()) == 2,
+                   msg="fleet healed after kill")
+    finally:
+        router.close()
+
+
+def test_replica_slow_site_triggers_hedge():
+    from deepvision_tpu.resilience import FaultInjector
+
+    inj = FaultInjector("replica_slow@1:0.5")
+    router, spawned = fake_fleet(2, hedge_after_s=0.05,
+                                 fault_injector=inj)
+    try:
+        r1 = router.submit(np.zeros(3, np.float32)).result(timeout=30)
+        t0 = time.perf_counter()
+        r2 = router.submit(np.ones(3, np.float32)).result(timeout=30)
+        dt = time.perf_counter() - t0
+        assert r1["by"] != r2["by"] or True  # both valid; key assert:
+        assert dt < 0.45                     # hedge dodged the slow site
+        assert router.telemetry.hedges == 1
+        assert router.telemetry.completed == 2
+    finally:
+        router.close()
+
+
+# ----------------------------------------------- Retry-After surfaces
+
+
+def test_engine_healthz_503_carries_retry_after_header():
+    """The PR 4 recovery path plus this PR's satellite: while the
+    dispatcher supervisor is in its crash-backoff window, /healthz is
+    503 AND tells the load balancer when to re-probe."""
+    import http.client
+    import http.server
+
+    import serve as serve_cli
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.resilience import FaultInjector
+    from deepvision_tpu.serve import InferenceEngine
+
+    eng = InferenceEngine(
+        [toy_model()], mesh=create_mesh(1, 1), buckets=(1, 4),
+        fault_injector=FaultInjector("crash@0"),
+        restart_backoff_s=3.0, restart_backoff_max_s=3.0)
+    try:
+        with pytest.raises(RuntimeError, match="crash"):
+            eng.submit(np.zeros(3, np.float32)).result(timeout=30)
+        deadline = time.monotonic() + 10
+        while not eng._recovering.is_set():
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        h = eng.health()
+        assert h["status"] == "recovering"
+        assert h["retry_after_s"] > 0
+        server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0),
+            serve_cli.make_handler(eng, type("A", (), {
+                "timeout_s": 5.0})()))
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.server_address[1], timeout=10)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            assert resp.status == 503
+            assert int(resp.getheader("Retry-After")) >= 1
+            resp.read()
+        finally:
+            server.shutdown()
+            server.server_close()
+    finally:
+        eng.close()
+
+
+def test_router_stats_and_summary_line_shape():
+    router, _ = fake_fleet(2)
+    try:
+        router.submit(np.zeros(3, np.float32)).result(timeout=30)
+        st = router.stats()
+        assert st["models"] == ["toy"]
+        assert len(st["replicas"]) == 2
+        assert st["health"]["status"] == "ok"
+        assert st["telemetry"]["completed"] == 1
+        line = router.summary_line()
+        assert line.startswith("[router] failovers=")
+        for tok in ("hedges=", "deaths=", "restarts=", "sheds=",
+                    "completed=1", "failed=0"):
+            assert tok in line, line
+    finally:
+        router.close()
+
+
+def test_router_close_is_clean_and_leaks_no_threads():
+    before = {t.name for t in threading.enumerate()}
+    router, _ = fake_fleet(2)
+    router.submit(np.zeros(3, np.float32)).result(timeout=30)
+    router.close()
+    router.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        router.submit(np.zeros(3, np.float32))
+    time.sleep(0.1)
+    after = {t.name for t in threading.enumerate()}
+    leaked = {n for n in after - before
+              if n.startswith(("router-", "serve-"))}
+    assert not leaked, leaked
+
+
+# ------------------------------------------- process replicas (slow)
+
+
+def test_transient_replica_error_retries_without_death_verdict():
+    """A request-level RuntimeError (the wire shape of a replica-side
+    dispatcher crash: HTTP 500 -> RuntimeError) fails over to another
+    replica WITHOUT condemning the first — the engine supervisor is
+    already healing it, and the health probe (not the request path)
+    decides draining."""
+    router, spawned = fake_fleet(2)
+    try:
+        a, b = spawned
+
+        orig = FakeReplica.request
+        calls = {"n": 0}
+
+        def flaky_once(self, model, x, **kw):
+            if self is a and calls["n"] == 0:
+                calls["n"] += 1
+                raise RuntimeError(f"{self.replica_id}: dispatcher "
+                                   "crashed mid-request")
+            return orig(self, model, x, **kw)
+
+        a.request = flaky_once.__get__(a)
+        # drive until the flaky replica is picked once (least-loaded
+        # routing may start on either)
+        for _ in range(8):
+            res = router.submit(np.zeros(3, np.float32),
+                                model="toy").result(timeout=10)
+            assert res["by"] in ("r1", "r2")
+            if calls["n"]:
+                break
+        assert calls["n"] == 1, "flaky replica was never picked"
+        # the failed attempt was retried, and NO death verdict landed
+        assert router.telemetry.replica_deaths == 0
+        assert router.telemetry.failed == 0
+        states = {s["id"]: s["state"] for s in router.stats()["replicas"]}
+        assert states == {"r1": "ready", "r2": "ready"}
+    finally:
+        router.close()
+
+
+def test_process_replica_death_verdict_requires_dead_process():
+    """A request-level failure on a LIVE child (dropped keep-alive,
+    crashed handler thread, HTTP 5xx) is retryable breaker food, never
+    a death verdict — condemning would SIGKILL a healthy replica and
+    pay a full respawn+recompile for one poison request. Only a
+    process that actually exited earns ReplicaDeadError."""
+    from deepvision_tpu.serve.replica import ProcessReplica
+
+    class _Proc:
+        returncode = None
+
+        def poll(self):
+            return self.returncode
+
+    class _Conn:
+        sock = None
+        timeout = None
+
+        def request(self, *a, **kw):
+            raise ConnectionResetError("peer reset")
+
+        def close(self):
+            pass
+
+    rep = ProcessReplica("r1", argv=["unused"])
+    rep._proc = _Proc()
+    rep._port = 1  # never dialed: the fake conn raises first
+    rep._conns.conn = _Conn()
+    with pytest.raises(RuntimeError) as ei:  # alive: NOT dead
+        rep._http("POST", "/v1/predict", "{}")
+    assert not isinstance(ei.value, ReplicaDeadError)
+    rep._proc.returncode = -9  # now the process really exited
+    rep._conns.conn = _Conn()
+    with pytest.raises(ReplicaDeadError):
+        rep._http("POST", "/v1/predict", "{}")
+
+    # an HTTP 5xx is an ANSWER from a live replica: request failure,
+    # not death
+    rep._proc.returncode = None
+    rep._http = lambda *a, **kw: (500, {}, b'{"error": "boom"}')
+    with pytest.raises(RuntimeError) as ei:
+        rep.request("toy", np.zeros(3, np.float32))
+    assert not isinstance(ei.value, ReplicaDeadError)
+
+
+def test_process_replica_forwards_deadline_to_child():
+    """The router's remaining budget rides in the payload as
+    ``timeout_s`` so the child stops working requests the router has
+    already timed out or hedged away (serve.py caps it at its own
+    --timeout-s ceiling)."""
+    from deepvision_tpu.serve.replica import ProcessReplica
+
+    rep = ProcessReplica("r1", argv=["unused"])
+    seen = {}
+
+    def fake_http(method, path, body, timeout_s):
+        seen["payload"] = json.loads(body)
+        return 200, {}, b'{"result": {"y": [1.0]}}'
+
+    rep._http = fake_http
+    rep.request("toy", np.zeros(3, np.float32), timeout_s=0.75)
+    assert seen["payload"]["timeout_s"] == 0.75
+    seen.clear()
+    rep.request("toy", np.zeros(3, np.float32))  # no deadline: absent
+    assert "timeout_s" not in seen["payload"]
+
+
+def test_process_replica_roundtrip_sigkill_and_dead_probe(tmp_path):
+    """The production backend end-to-end: spawn serve.py as a child on
+    an ephemeral port (--port-file), round-trip a request, then SIGKILL
+    it and assert the replica surface reports the death the way the
+    router's failover machinery expects."""
+    from deepvision_tpu.serve.replica import ProcessReplica, replica_argv
+
+    argv = replica_argv(["lenet5"], buckets="1",
+                        extra=["--num-classes", "10"])
+    rep = ProcessReplica("r1", argv)
+    rep.start()
+    try:
+        res = rep.request("lenet5",
+                          np.zeros((32, 32, 1), np.float32),
+                          timeout_s=60.0)
+        assert len(res["classes"]) == 5
+        assert rep.probe()["status"] == "ok"
+        st = rep.stats()
+        assert st["telemetry"]["completed"] >= 1
+        rep.kill()  # real SIGKILL
+        with pytest.raises(ReplicaDeadError):
+            rep.probe()
+        with pytest.raises(ReplicaDeadError):
+            rep.request("lenet5", np.zeros((32, 32, 1), np.float32))
+    finally:
+        rep.stop()

@@ -437,16 +437,88 @@ def test_http_surface_predict_stats_and_shed():
             assert stats["cache"]["misses"] == 3
             assert stats["telemetry"]["completed"] >= 1
 
+            # the server speaks HTTP/1.1 keep-alive now: a client
+            # reusing the connection must drain each body (read())
+            # before the next request — which also pins that every
+            # handler path sets Content-Length correctly
             conn.request("GET", "/healthz")
-            assert conn.getresponse().status == 200
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
 
             conn.request("POST", "/v1/predict",
                          json.dumps({"model": "toy", "input": "bad"}))
-            assert conn.getresponse().status == 400
+            resp = conn.getresponse()
+            assert resp.status == 400
+            resp.read()
 
             # valid JSON but not an object: 400, not a dead handler
             conn.request("POST", "/v1/predict", json.dumps([1, 2, 3]))
-            assert conn.getresponse().status == 400
+            resp = conn.getresponse()
+            assert resp.status == 400
+            resp.read()
+
+            # binary wire format: base64 raw bytes + shape
+            import base64
+
+            x = np.array([1, 2, 3], np.float32)
+            conn.request("POST", "/v1/predict", json.dumps({
+                "model": "toy",
+                "input_b64": base64.b64encode(x.tobytes()).decode(),
+                "shape": [3]}))
+            resp = conn.getresponse()
+            assert resp.status == 200
+            res = json.loads(resp.read())["result"]
+            np.testing.assert_array_equal(
+                np.asarray(res["y"], np.float32), expected_toy(x))
+
+            # per-request deadline (the fleet router forwards its
+            # remaining budget): honored when sane, 400 when not
+            conn.request("POST", "/v1/predict", json.dumps(
+                {"model": "toy", "input": [1.0, 2.0, 3.0],
+                 "timeout_s": 10.0}))
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            conn.request("POST", "/v1/predict", json.dumps(
+                {"model": "toy", "input": [1.0, 2.0, 3.0],
+                 "timeout_s": 0}))
+            resp = conn.getresponse()
+            assert resp.status == 400
+            resp.read()
+            # a server-side RuntimeError (dispatcher crash, engine
+            # closed) is a 500 — retryable server fault — NOT a 400:
+            # the fleet router maps 400 to a terminal client error, so
+            # a 400 here would bury exactly the fault class failover
+            # exists to absorb
+            real_submit = eng.submit
+            try:
+                def boom(*a, **kw):
+                    raise RuntimeError("dispatcher crashed: injected")
+                eng.submit = boom
+                conn.request("POST", "/v1/predict", json.dumps(
+                    {"model": "toy", "input": [1.0, 2.0, 3.0]}))
+                resp = conn.getresponse()
+                assert resp.status == 500
+                resp.read()
+            finally:
+                eng.submit = real_submit
+
+            # ...and it must actually reach the engine: a paused
+            # engine + a 0.3s request deadline is a 504 in ~0.3s, not
+            # a hang until the blanket --timeout-s
+            eng.pause()
+            try:
+                t0 = time.perf_counter()
+                conn.request("POST", "/v1/predict", json.dumps(
+                    {"model": "toy", "input": [1.0, 2.0, 3.0],
+                     "timeout_s": 0.3}))
+                resp = conn.getresponse()
+                assert resp.status == 504
+                resp.read()
+                assert time.perf_counter() - t0 < 5.0
+            finally:
+                eng.resume()
         finally:
             server.shutdown()
             server.server_close()

@@ -29,6 +29,9 @@ Checker codes (tools/jaxlint/checkers.py):
     JX112  time.time()/perf_counter() delta around a compiled-step call
            without block_until_ready between call and stop (async
            dispatch: the delta times enqueue, not compute)
+    JX113  bare time.sleep inside a supervisor/dispatcher/router loop
+           (ignores the stop event: shutdown hangs for the full
+           backoff; use Event.wait(timeout))
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

@@ -995,3 +995,51 @@ class AsyncDispatchTimingChecker(Checker):
                 "async dispatch makes this time enqueue, not compute; "
                 "sync the result (jax.block_until_ready) before "
                 "reading the clock")
+
+
+@register_checker
+class LoopSleepChecker(Checker):
+    """Bare ``time.sleep`` inside a supervised service loop (dispatcher
+    / supervisor / router / probe / autoscaler): the sleep ignores the
+    loop's stop event, so ``close()`` blocks until the full backoff
+    expires — and under a long crash backoff that is SECONDS of
+    shutdown hang per loop. PR 4 established the stop-responsive idiom
+    (``stop_event.wait(backoff)`` sleeps identically but wakes
+    instantly on close); which functions count as service loops is the
+    ``loop_sleep_funcs`` knob (``jaxlint.toml``)."""
+
+    code = "JX113"
+    name = "stop-blind-sleep-in-loop"
+    description = ("bare time.sleep inside a supervisor/dispatcher/"
+                   "router loop (ignores the stop event; use "
+                   "Event.wait(timeout))")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.loop_sleep_funcs
+        flagged: set[int] = set()  # nested loops: report a call once
+        for info in mod.functions:
+            if not any(fnmatch.fnmatch(info.node.name, p)
+                       for p in patterns):
+                continue
+            for loop in ast.walk(info.node):
+                if not isinstance(loop, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                    continue
+                for stmt in loop.body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call) \
+                                or id(sub) in flagged:
+                            continue
+                        name = call_name(sub)
+                        bare = (isinstance(sub.func, ast.Name)
+                                and sub.func.id == "sleep")
+                        if name == "time.sleep" or bare:
+                            flagged.add(id(sub))
+                            yield mod.finding(
+                                sub, self.code,
+                                f"'{name or 'sleep'}' inside the "
+                                f"service loop of '{info.node.name}' "
+                                "ignores the stop event — close() "
+                                "blocks until the sleep expires; use "
+                                "the loop's stop Event.wait(timeout) "
+                                "(stop-responsive backoff, PR 4 idiom)")

@@ -266,6 +266,15 @@ class LintConfig:
         "*_train_step", "*_eval_step", "*_step_fn", "train_step",
         "eval_step",
     ])
+    # Function-name patterns treated as supervised service loops
+    # (JX113): a bare time.sleep inside a loop there ignores the stop
+    # event, so shutdown blocks until the sleep expires — PR 4's
+    # stop-responsive idiom is Event.wait(backoff), which sleeps the
+    # same but wakes instantly on close().
+    loop_sleep_funcs: list[str] = field(default_factory=lambda: [
+        "*supervise*", "*dispatch*", "*router*", "*probe*",
+        "*autoscale*", "*respawn*", "*_loop*", "*watchdog*",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -285,7 +294,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "traced_name_patterns", "jit_wrappers", "static_return_calls",
         "key_fresheners", "key_name_patterns", "constraint_funcs",
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
-        "timed_funcs", "disable",
+        "timed_funcs", "loop_sleep_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))
