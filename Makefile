@@ -105,6 +105,17 @@ obs-smoke:
 	$(PY) tools/obs_smoke.py 2>&1 | tee -a "$$L" && \
 	echo "obs-smoke OK (trace attribution + /metrics exposition)"
 
+# input-pipeline smoke: drive the REAL record readers + prefetcher on a
+# tiny self-built JPEG record set and assert the split pipeline's wire
+# contract (ISSUE 7): uint8 crossing H2D, measured h2d_bytes_per_image
+# >= 3.9x smaller than the f32 reference path, and host-vs-device
+# augmentation parity at pinned tolerance on shared decisions — the
+# `make check` input-wall gate (data/device_aug.py + data/loader.py)
+feed-smoke:
+	@mkdir -p logs; L="logs/feed-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	$(PY) tools/feed_smoke.py 2>&1 | tee "$$L" && \
+	grep -q "feed-smoke OK" "$$L"
+
 # chaos smoke: a scripted fault schedule on the lenet synthetic config —
 # one NaN step (epoch-2 batch 2), one corrupt checkpoint (the epoch-1
 # save, i.e. the rollback's first restore candidate), and two transient
@@ -125,7 +136,7 @@ chaos-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke router-smoke obs-smoke chaos-smoke
+check: lint serve-smoke router-smoke obs-smoke chaos-smoke feed-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -249,4 +260,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint check serve-smoke router-smoke obs-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint check serve-smoke router-smoke obs-smoke feed-smoke bench dryrun tensorboard find-python list-models rehearsal

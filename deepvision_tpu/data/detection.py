@@ -24,6 +24,7 @@ from pathlib import Path
 
 import numpy as np
 
+from deepvision_tpu.data.image_io import tf_wire_uint8
 from deepvision_tpu.data.padding import pad_partial_batch
 
 MAX_BOXES = 100  # matches the loss's true-box cap (ref: yolov3.py:448-454)
@@ -140,11 +141,20 @@ def random_crop(image, boxes, seed=None):
     return tf.cond(crop, do_crop, lambda: (image, boxes))
 
 
-def to_model_inputs(image, boxes, labels, size: int):
-    """resize + [-1,1] scale + corners→xywh + pad to MAX_BOXES."""
+def to_model_inputs(image, boxes, labels, size: int,
+                    as_uint8: bool = False):
+    """resize + [-1,1] scale + corners→xywh + pad to MAX_BOXES.
+
+    ``as_uint8`` ships rounded uint8 pixels instead (4x less wire
+    traffic); the train/eval steps' ``maybe_normalize(…, "tanh")``
+    applies the /127.5 - 1 scale on device (<0.5-LSB rounding vs the
+    reference's f32 path — the same contract as the ImageNet reader)."""
     tf = _tf()
     image = tf.image.resize(tf.cast(image, tf.float32), [size, size])
-    image = image / 127.5 - 1.0  # ref: preprocess.py:25
+    if as_uint8:
+        image = tf_wire_uint8(tf, image)
+    else:
+        image = image / 127.5 - 1.0  # ref: preprocess.py:25
     xy = (boxes[:, 0:2] + boxes[:, 2:4]) / 2.0
     wh = boxes[:, 2:4] - boxes[:, 0:2]
     xywh = tf.concat([xy, wh], axis=-1)
@@ -168,7 +178,17 @@ def make_detection_dataset(
     num_process: int = 1,
     process_index: int = 0,
     seed: int = 0,
+    as_uint8: bool = False,
+    device_aug: bool = False,
 ):
+    """``as_uint8`` ships uint8 pixels (normalize-on-device wire
+    contract); ``device_aug`` additionally moves the horizontal flip —
+    image AND box mirroring together — into the compiled step
+    (``device_aug.DeviceAugment("detection")``, wired by train.py
+    ``--device-aug``), leaving the host with parse + bbox-preserving
+    crop + resize only. The crop stays on the host: its window depends
+    on the per-sample box union and reshapes the image, which needs the
+    dynamic-shape freedom only the host pipeline has."""
     tf = _tf()
     files = tf.data.Dataset.list_files(
         file_pattern, shuffle=is_training, seed=seed
@@ -183,9 +203,11 @@ def make_detection_dataset(
     def prep(serialized):
         image, boxes, labels = parse_detection_example(serialized)
         if is_training:
-            image, boxes = random_flip(image, boxes)
+            if not device_aug:  # flip moves into the step (with the
+                image, boxes = random_flip(image, boxes)  # box mirror)
             image, boxes = random_crop(image, boxes)
-        return to_model_inputs(image, boxes, labels, size)
+        return to_model_inputs(image, boxes, labels, size,
+                               as_uint8 or device_aug)
 
     ds = ds.map(prep, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.batch(batch_size, drop_remainder=is_training)
@@ -252,7 +274,7 @@ def synthetic_batches(images, boxes, labels, batch_size, *, rng=None,
 def make_detection_data(
     data_dir: str, batch_size: int, size: int = 416,
     *, train_pattern: str = "train-*", val_pattern: str = "val-*",
-    steps_per_epoch: int,
+    steps_per_epoch: int, device_aug: bool = False,
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
@@ -287,6 +309,7 @@ def make_detection_data(
         ds = make_detection_dataset(
             str(d / train_pattern), local_bs, size, is_training=True,
             num_process=nproc, process_index=pid, seed=epoch,
+            device_aug=device_aug,
         )
         return _iter(ds, limit=steps_per_epoch)
 

@@ -24,3 +24,18 @@ def ensure_rgb_jpeg(data: bytes) -> tuple[bytes, int, int]:
     buf = io.BytesIO()
     img.convert("RGB").save(buf, "JPEG", quality=95)
     return buf.getvalue(), width, height
+
+
+def tf_wire_uint8(tf, image):
+    """Round-clip-cast f32 pixels to the uint8 WIRE dtype (tf graph op).
+
+    THE canonical host-side quantization of the split input pipeline:
+    every reader that ships uint8 over H2D goes through this one
+    expression, because the round-then-clip semantics are what the
+    device-stage parity twins pin against (``transforms.ToUint8``, the
+    round-through-uint8 in ``data/device_aug.py``) — a reader
+    quantizing differently (plain truncation) drifts 1 LSB from the
+    tested contract. Takes the caller's lazily imported ``tf`` module
+    so this module stays importable without TensorFlow."""
+    return tf.cast(tf.clip_by_value(tf.round(image), 0.0, 255.0),
+                   tf.uint8)

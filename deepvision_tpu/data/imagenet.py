@@ -25,6 +25,7 @@ from pathlib import Path
 
 import numpy as np
 
+from deepvision_tpu.data.image_io import tf_wire_uint8
 from deepvision_tpu.data.padding import pad_partial_batch
 from deepvision_tpu.ops.normalize import (  # single source of truth
     IMAGENET_CHANNEL_MEANS as CHANNEL_MEANS,
@@ -88,7 +89,8 @@ def _random_jitter(image, amount: float):
 
 
 def parse_and_preprocess(serialized, size: int, is_training: bool,
-                         as_uint8: bool = False, augment: str = "tf"):
+                         as_uint8: bool = False, augment: str = "tf",
+                         host_stage: str | None = None):
     """One Example -> (image [size,size,3], int32 label).
 
     Default emits f32 mean-subtracted images (full reference parity).
@@ -96,6 +98,22 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     less host↔device wire traffic; the train step applies the matching
     ``ops.normalize`` kind on device (TPU-first: HBM bandwidth is cheaper
     than host link bandwidth).
+
+    ``host_stage`` (training only; implies uint8 out) shrinks the host's
+    job to the SPLIT pipeline's decode stage, with the remaining ops run
+    on device inside the step (``data/device_aug.py``, keyed through the
+    step's KeySeq — wire the matching ``DeviceAugment`` via
+    ``train.py --device-aug``):
+
+      - ``"crop"``: decode + resize + random ``size``² crop — flip /
+        jitter / normalize move on-device. The spatial crop DRAW stays
+        in tf.data (a uint8 slice costs the host nothing) so the wire
+        ships exactly ``size``² 1-byte pixels: the full 4x byte win.
+      - ``"canvas"``: decode + resize + center **canvas** crop
+        (``resize_min_for(size)``², uint8) — the crop itself also moves
+        on-device (``DeviceAugment(crop=size)``). Costs
+        ~``(canvas/size)²`` more wire bytes than ``"crop"``; for hosts
+        where the link is not the binding wall.
 
     ``augment`` selects the reference lineage:
       - ``"tf"``: crop/flip + channel-mean subtraction
@@ -106,6 +124,9 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     """
     if augment not in ("tf", "pt"):
         raise ValueError(f"unknown augment lineage {augment!r}")
+    if host_stage not in (None, "crop", "canvas"):
+        raise ValueError(f"unknown host_stage {host_stage!r}; "
+                         "None, 'crop' or 'canvas'")
     tf = _tf()
     feats = tf.io.parse_single_example(
         serialized,
@@ -116,6 +137,9 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     )
     image = tf.io.decode_jpeg(feats["image/encoded"], channels=3)
     image = tf.cast(image, tf.float32)
+    # 1-indexed on disk (ref builder) -> 0-indexed; ONE definition so
+    # the split-pipeline early return and the f32 tail can't skew
+    label = tf.cast(feats["image/class/label"], tf.int32) - 1
 
     # aspect-preserving resize: shorter side -> resize_min_for(size)
     # (ref: data_load.py _aspect_preserving_resize)
@@ -126,6 +150,18 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
     new_w = tf.cast(tf.math.ceil(w * scale), tf.int32)
     image = tf.image.resize(image, [new_h, new_w])
 
+    if is_training and host_stage is not None:
+        # SPLIT-pipeline host stage: pure I/O — flip/jitter/normalize
+        # (and for "canvas" the crop too) happen on device in the step
+        if host_stage == "canvas":
+            canvas = resize_min_for(size)
+            off_h = (new_h - canvas) // 2
+            off_w = (new_w - canvas) // 2
+            image = tf.slice(image, [off_h, off_w, 0],
+                             [canvas, canvas, 3])
+        else:
+            image = tf.image.random_crop(image, [size, size, 3])
+        return tf_wire_uint8(tf, image), label
     if is_training:
         image = tf.image.random_crop(image, [size, size, 3])
         image = tf.image.random_flip_left_right(image)
@@ -137,20 +173,18 @@ def parse_and_preprocess(serialized, size: int, is_training: bool,
         off_w = (new_w - size) // 2
         image = tf.slice(image, [off_h, off_w, 0], [size, size, 3])
     if as_uint8:
-        image = tf.cast(tf.clip_by_value(tf.round(image), 0.0, 255.0),
-                        tf.uint8)
+        image = tf_wire_uint8(tf, image)
     elif augment == "pt":
         image = (image / 255.0 - tf.constant(TORCH_MEANS, tf.float32)) \
             / tf.constant(TORCH_STDS, tf.float32)
     else:
         image = image - tf.constant(CHANNEL_MEANS, tf.float32)
 
-    label = tf.cast(feats["image/class/label"], tf.int32) - 1
     return image, label
 
 
 def parse_raw_crop(serialized, size: int, is_training: bool,
-                   augment: str = "tf"):
+                   augment: str = "tf", host_stage: str | None = None):
     """One pre-decoded raw-frame Example (data/builders/raw_crops.py) ->
     (uint8 image [size,size,3], int32 label). No JPEG decode: parse +
     reshape + random crop/flip only — the fast path when the host CPU,
@@ -159,9 +193,19 @@ def parse_raw_crop(serialized, size: int, is_training: bool,
     resize, variable long side), so the random crop samples the same
     support region the JPEG path's ``random_crop`` does. ColorJitter
     (augment="pt") still applies; normalization always runs on device
-    (uint8 wire)."""
+    (uint8 wire).
+
+    ``host_stage="crop"`` moves flip/jitter on-device too (split
+    pipeline, as in :func:`parse_and_preprocess`); "canvas" is not
+    available here — the stored frame's long side is variable, and a
+    batch needs one static shape."""
     if augment not in ("tf", "pt"):
         raise ValueError(f"unknown augment lineage {augment!r}")
+    if host_stage not in (None, "crop"):
+        raise ValueError(
+            f"raw-crop reader supports host_stage None or 'crop', got "
+            f"{host_stage!r} (variable frame sizes cannot ship a fixed "
+            "canvas)")
     tf = _tf()
     feats = tf.io.parse_single_example(
         serialized,
@@ -179,10 +223,12 @@ def parse_raw_crop(serialized, size: int, is_training: bool,
     )
     if is_training:
         image = tf.image.random_crop(image, [size, size, 3])
-        image = tf.image.random_flip_left_right(image)
-        if augment == "pt":
-            jittered = _random_jitter(tf.cast(image, tf.float32), PT_JITTER)
-            image = tf.cast(jittered, tf.uint8)
+        if host_stage is None:
+            image = tf.image.random_flip_left_right(image)
+            if augment == "pt":
+                jittered = _random_jitter(tf.cast(image, tf.float32),
+                                          PT_JITTER)
+                image = tf.cast(jittered, tf.uint8)
     else:
         off_h = (h - size) // 2
         off_w = (w - size) // 2
@@ -201,13 +247,20 @@ def _records_pipeline(
     num_process: int,
     process_index: int,
     seed: int,
+    private_threads: int | None = None,
 ):
     """Shared scaffolding for the JPEG and raw-crop readers: per-process
     file sharding (the ``experimental_distribute_dataset`` analog —
     ref: YOLO/tensorflow/train.py:291-294) and the epoch-seeded shuffle
     (resume at epoch N reproduces the order an uninterrupted run would
     have seen — SURVEY §5.3, the deterministic data-order restore the
-    reference lacks)."""
+    reference lacks).
+
+    ``private_threads`` caps the pipeline to its own N-thread pool
+    (tf.data threading option) instead of AUTOTUNE's shared pool —
+    the knob that keeps K loader processes (``data/loader.py``) from
+    oversubscribing the host at K x AUTOTUNE threads each, and that
+    the bench uses to measure process fan-out at a controlled width."""
     tf = _tf()
     files = tf.data.Dataset.list_files(file_pattern, shuffle=is_training,
                                        seed=seed)
@@ -218,7 +271,12 @@ def _records_pipeline(
         ds = ds.shuffle(shuffle_buffer, seed=seed).repeat()
     ds = ds.map(parse_fn, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.batch(batch_size, drop_remainder=is_training)
-    return ds.prefetch(tf.data.AUTOTUNE)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+    if private_threads is not None:
+        opts = tf.data.Options()
+        opts.threading.private_threadpool_size = private_threads
+        ds = ds.with_options(opts)
+    return ds
 
 
 def make_raw_dataset(
@@ -233,6 +291,8 @@ def make_raw_dataset(
     process_index: int = 0,
     augment: str = "tf",
     seed: int = 0,
+    host_stage: str | None = None,
+    private_threads: int | None = None,
 ):
     """tf.data pipeline over raw-crop shards (``raw-<split>-*``); same
     sharding/epoch-seeding contract as :func:`make_dataset`. ``size``
@@ -244,9 +304,11 @@ def make_raw_dataset(
         )
     return _records_pipeline(
         file_pattern, batch_size,
-        lambda s: parse_raw_crop(s, size, is_training, augment),
+        lambda s: parse_raw_crop(s, size, is_training, augment,
+                                 host_stage),
         is_training=is_training, shuffle_buffer=shuffle_buffer,
         num_process=num_process, process_index=process_index, seed=seed,
+        private_threads=private_threads,
     )
 
 
@@ -262,14 +324,17 @@ def make_dataset(
     as_uint8: bool = False,
     augment: str = "tf",
     seed: int = 0,
+    host_stage: str | None = None,
+    private_threads: int | None = None,
 ):
     """tf.data pipeline over sharded JPEG TFRecords (reference schema)."""
     return _records_pipeline(
         file_pattern, batch_size,
         lambda s: parse_and_preprocess(s, size, is_training, as_uint8,
-                                       augment),
+                                       augment, host_stage),
         is_training=is_training, shuffle_buffer=shuffle_buffer,
         num_process=num_process, process_index=process_index, seed=seed,
+        private_threads=private_threads,
     )
 
 
@@ -286,11 +351,61 @@ def _as_batches(ds, limit: int | None = None, pad_to: int | None = None):
         yield batch
 
 
+class _TrainShardFactory:
+    """Picklable per-worker dataset factory for the multi-process host
+    loader (``data/loader.MultiProcessLoader``): worker ``w`` of ``n``
+    reads the composed file shard ``base_index*n + w`` of
+    ``base_shards*n`` — the same deterministic file-sharding contract
+    multi-host training already uses, one level deeper. Carries only
+    plain config (no tf/jax objects), so spawn can ship it; the child
+    builds its own tf.data pipeline on a fresh interpreter."""
+
+    def __init__(self, *, kind: str, pattern: str, batch_size: int,
+                 size: int, augment: str, seed: int, base_shards: int,
+                 base_index: int, host_stage: str | None,
+                 as_uint8: bool, stored: int | None = None,
+                 private_threads: int | None = None):
+        self.kind = kind  # "jpeg" | "raw"
+        self.pattern = pattern
+        self.batch_size = batch_size
+        self.size = size
+        self.augment = augment
+        self.seed = seed
+        self.base_shards = base_shards
+        self.base_index = base_index
+        self.host_stage = host_stage
+        self.as_uint8 = as_uint8
+        self.stored = stored
+        self.private_threads = private_threads
+
+    def __call__(self, worker_id: int, num_workers: int):
+        nproc = self.base_shards * num_workers
+        pid = self.base_index * num_workers + worker_id
+        if self.kind == "raw":
+            ds = make_raw_dataset(
+                self.pattern, self.batch_size, self.size,
+                is_training=True, stored=self.stored,
+                augment=self.augment, num_process=nproc,
+                process_index=pid, seed=self.seed,
+                host_stage=self.host_stage,
+                private_threads=self.private_threads)
+        else:
+            ds = make_dataset(
+                self.pattern, self.batch_size, self.size,
+                is_training=True, as_uint8=self.as_uint8,
+                augment=self.augment, num_process=nproc,
+                process_index=pid, seed=self.seed,
+                host_stage=self.host_stage,
+                private_threads=self.private_threads)
+        return _as_batches(ds)
+
+
 def make_imagenet_data(
     data_dir: str, batch_size: int, size: int = 224,
     *, train_images: int = 1_281_167, val_images: int = 50_000,
     train_as_uint8: bool = True, augment: str = "tf",
     use_raw: bool | None = None, steps_per_epoch: int | None = None,
+    device_aug: bool = False, loader_workers: int = 1,
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
@@ -301,6 +416,15 @@ def make_imagenet_data(
     Training batches default to uint8 wire transfer (mean subtraction on
     device — ops/normalize.py; <0.5-LSB rounding vs the reference's f32
     path); validation stays f32 for exact preprocessing parity.
+
+    ``device_aug``: host emits decode-stage-only uint8 crops
+    (``host_stage="crop"``) and the caller MUST run the matching device
+    stage inside the step (``device_aug.augment_step`` — train.py
+    ``--device-aug`` wires both ends); flip/jitter/normalize leave the
+    host entirely. ``loader_workers`` > 1 spreads the host decode over
+    N spawned processes (``data/loader.py``; deterministic round-robin
+    merge over disjoint file shards — spawned fresh per epoch, seconds
+    of startup amortized over the epoch).
     """
     import jax
 
@@ -357,22 +481,36 @@ def make_imagenet_data(
               f"{meta_path.name}, stored={raw_stored}); pass "
               f"use_raw=False / --no-raw to read the JPEG records instead")
 
+    host_stage = "crop" if device_aug else None
+
     def train_data(epoch: int):
         # Multi-host (train_dist.py): each process reads a DISJOINT file
         # shard and batches its local share; core.shard_batch assembles
         # the locals into the global array (local × nproc = global).
+        if loader_workers > 1:
+            from deepvision_tpu.data.loader import mp_batches
+
+            factory = _TrainShardFactory(
+                kind="raw" if have_raw else "jpeg",
+                pattern=str(d / ("raw-train-*" if have_raw
+                                 else "train-*")),
+                batch_size=local_bs, size=size, augment=augment,
+                seed=epoch, base_shards=nproc, base_index=pid,
+                host_stage=host_stage, as_uint8=train_as_uint8,
+                stored=raw_stored)
+            return mp_batches(factory, loader_workers, steps)
         if have_raw:
             ds = make_raw_dataset(str(d / "raw-train-*"), local_bs, size,
                                   is_training=True, stored=raw_stored,
                                   augment=augment,
                                   num_process=nproc, process_index=pid,
-                                  seed=epoch)
+                                  seed=epoch, host_stage=host_stage)
         else:
             ds = make_dataset(str(d / "train-*"), local_bs, size,
                               is_training=True, as_uint8=train_as_uint8,
                               augment=augment,
                               num_process=nproc, process_index=pid,
-                              seed=epoch)
+                              seed=epoch, host_stage=host_stage)
         return _as_batches(ds, steps)
 
     def val_data():

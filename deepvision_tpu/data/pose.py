@@ -26,6 +26,7 @@ from pathlib import Path
 
 import numpy as np
 
+from deepvision_tpu.data.image_io import tf_wire_uint8
 from deepvision_tpu.data.padding import iter_array_batches, iter_tf_batches
 
 NUM_JOINTS = 16
@@ -98,11 +99,17 @@ def crop_person_roi(image, kx, ky, v, scale, margin):
     return crop, nkx, nky
 
 
-def to_model_inputs(image, kx, ky, v, size: int):
-    """resize to size² + [-1,1] scale; fixed (K,) keypoint shapes."""
+def to_model_inputs(image, kx, ky, v, size: int, as_uint8: bool = False):
+    """resize to size² + [-1,1] scale; fixed (K,) keypoint shapes.
+
+    ``as_uint8`` ships rounded uint8 pixels (4x less wire traffic); the
+    steps' ``maybe_normalize(…, "tanh")`` scales on device."""
     tf = _tf()
     image = tf.image.resize(tf.cast(image, tf.float32), [size, size])
-    image = image / 127.5 - 1.0
+    if as_uint8:
+        image = tf_wire_uint8(tf, image)
+    else:
+        image = image / 127.5 - 1.0
 
     def fix(t, dtype):
         t = t[:NUM_JOINTS]
@@ -124,7 +131,14 @@ def make_pose_dataset(
     num_process: int = 1,
     process_index: int = 0,
     seed: int = 0,
+    as_uint8: bool = False,
 ):
+    """``as_uint8`` ships uint8 pixels (normalize-on-device wire
+    contract). The ROI crop stays host-side — its window is the
+    per-person visible-keypoint bbox, dynamic-shaped by nature; the
+    device stage (``DeviceAugment("pose")``, train.py ``--device-aug``)
+    adds the left/right flip the reference pipeline lacks, with the
+    MPII joint-channel swap applied consistently."""
     tf = _tf()
     files = tf.data.Dataset.list_files(
         file_pattern, shuffle=is_training, seed=seed
@@ -143,7 +157,7 @@ def make_pose_dataset(
         else:
             margin = tf.constant(0.2)  # ref default (ref: :43)
         image, kx, ky = crop_person_roi(image, kx, ky, v, scale, margin)
-        return to_model_inputs(image, kx, ky, v, size)
+        return to_model_inputs(image, kx, ky, v, size, as_uint8)
 
     ds = ds.map(prep, num_parallel_calls=tf.data.AUTOTUNE)
     ds = ds.batch(batch_size, drop_remainder=is_training)
@@ -186,7 +200,7 @@ def synthetic_pose_batches(images, kx, ky, v, batch_size, *, rng=None,
 def make_pose_data(
     data_dir: str, batch_size: int, size: int = 256,
     *, train_pattern: str = "train-*", val_pattern: str = "val-*",
-    steps_per_epoch: int,
+    steps_per_epoch: int, device_aug: bool = False,
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
@@ -211,6 +225,7 @@ def make_pose_data(
         ds = make_pose_dataset(
             str(d / train_pattern), local_bs, size, is_training=True,
             num_process=nproc, process_index=pid, seed=epoch,
+            as_uint8=device_aug,
         )
         return iter_tf_batches(ds, keys, limit=steps_per_epoch)
 

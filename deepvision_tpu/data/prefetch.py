@@ -81,6 +81,60 @@ class FeedTelemetry:
         self._h = {s: reg.register(f"{namespace}_{s}", Histogram())
                    for s in _STAGES}
         self._batches = reg.register(f"{namespace}_batches", Counter())
+        # wire accounting (ISSUE 7): what actually crosses the host->
+        # device link, so the bench can PROVE the uint8 wire (4x fewer
+        # bytes than f32 pixels) instead of asserting it. Bytes/images
+        # are obs counters (`input_h2d_bytes`/`input_h2d_images` in the
+        # registry snapshot); the wire dtype is the image leaf's dtype
+        # string (not a metric — carried on the summary).
+        self._h2d_bytes = reg.register(f"{namespace}_h2d_bytes", Counter())
+        self._h2d_images = reg.register(f"{namespace}_h2d_images",
+                                        Counter())
+        self.wire_dtype: str | None = None
+
+    def record_wire(self, batch) -> None:
+        """Account one host batch about to cross the wire: total bytes
+        over every leaf, image count, and the image leaves' dtype (the
+        wire contract this PR's bench gates on). Called by the producer
+        BEFORE ``shard_fn`` — these are the bytes ``device_put`` ships.
+
+        "Images" are every (B,H,W,C) leaf's batch rows SUMMED — a
+        CycleGAN batch carries TWO canvases ('a' and 'b'), and counting
+        only one would double the reported bytes/image. Target leaves
+        (labels (B,), boxes (B,M,4), keypoints (B,K)) are sub-4-D and
+        never counted as images (their bytes still count — they cross
+        the wire too)."""
+        if isinstance(batch, dict):
+            raw = list(batch.values())
+        elif isinstance(batch, (list, tuple)):
+            raw = list(batch)
+        else:
+            raw = [batch]
+        leaves = [v for v in raw if hasattr(v, "nbytes")]
+        if not leaves:
+            return
+        self._h2d_bytes.inc(int(sum(v.nbytes for v in leaves)))
+        images = [v for v in leaves if getattr(v, "ndim", 0) >= 4]
+        if not images:  # imageless batch: fall back to the lead leaf
+            images = leaves[:1]
+        self._h2d_images.inc(int(sum(len(v) for v in images)))
+        self.wire_dtype = str(images[0].dtype)
+
+    @property
+    def h2d_bytes(self) -> int:
+        return self._h2d_bytes.value
+
+    @property
+    def h2d_images(self) -> int:
+        return self._h2d_images.value
+
+    @property
+    def h2d_bytes_per_image(self) -> float:
+        """Measured wire bytes per image (0.0 until a batch crossed);
+        constant across warmup/steady state for fixed batch geometry,
+        so it needs no snapshot-delta scoping."""
+        n = self._h2d_images.value
+        return self._h2d_bytes.value / n if n else 0.0
 
     def reset(self) -> None:
         """Zero all counters. NOTE: while a producer thread is live this
@@ -92,6 +146,9 @@ class FeedTelemetry:
         for h in self._h.values():
             h.reset()
         self._batches.reset()
+        self._h2d_bytes.reset()
+        self._h2d_images.reset()
+        self.wire_dtype = None
 
     # legacy accumulator surface: `tel.host_wait_s += dt` (the producer
     # and consumer hot paths) and plain assignment both route through
@@ -155,6 +212,10 @@ class FeedTelemetry:
             "input_wait_frac": (
                 round(wait / (wait + busy), 4) if wait + busy > 0 else 0.0
             ),
+            # wire accounting (whole-run, not since-scoped: bytes/image
+            # is geometry, constant across warmup vs steady state)
+            "h2d_bytes_per_image": round(self.h2d_bytes_per_image, 1),
+            "wire_dtype": self.wire_dtype,
         }
 
 
@@ -231,7 +292,10 @@ class DevicePrefetcher:
                     return
                 t1 = time.perf_counter()
                 tel.host_wait_s += t1 - t0
-                with span("shard", cat="feed"):
+                tel.record_wire(batch)  # bytes/dtype BEFORE device_put
+                with span("shard", cat="feed",
+                          args={"h2d_bytes": tel.h2d_bytes,
+                                "wire_dtype": tel.wire_dtype}):
                     device_batch = self._shard(batch)  # async H2D in flight
                 tel.shard_s += time.perf_counter() - t1
                 if not self._put((_BATCH, device_batch)):

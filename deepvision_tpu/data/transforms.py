@@ -9,6 +9,18 @@ converter-parity tests. The hot TPU path uses the tf.data twin
 (data/imagenet.py) — these exist for semantic parity checking and CPU-side
 tooling, not for feeding pods.
 
+Two-stage split (ISSUE 7): the ``imagenet_*_transform`` composes below
+are the FULL host pipeline (decode -> ... -> normalized f32) — the
+reference-parity path, 4-byte pixels on the wire. The
+``imagenet_host_transform`` compose is the HOST STAGE of the split
+pipeline: decode + resize + center canvas crop, **uint8 HWC out**
+(1-byte pixels, 4x less H2D traffic); every remaining op — random
+crop, flip, color jitter, normalize, mixup — runs inside the compiled
+step via the device twin (``data/device_aug.py``), keyed through
+``core.prng.KeySeq``. The numpy ops here double as the parity oracle:
+``tests/test_device_aug.py`` pins host-vs-device agreement op by op at
+tolerance, with shared explicit decisions.
+
 Divergence note (documented, ref parity kept where it matters): the PT
 ColorJitter does a PIL round-trip (ref: data_load.py:278-296); here the
 equivalent brightness/contrast/saturation jitters are computed directly in
@@ -99,6 +111,30 @@ class ToFloat:
         return image.astype(np.float32) / 255.0
 
 
+class EnsureRGB:
+    """Grayscale -> 3 channels, dtype preserved (the channel repair
+    ToFloat performs, split out so the uint8 host stage can use it
+    without the f32 conversion)."""
+
+    def __call__(self, rng, image):
+        if image.ndim == 2:
+            image = np.stack([image] * 3, axis=-1)
+        elif image.shape[-1] == 1:
+            image = np.repeat(image, 3, axis=-1)
+        return image
+
+
+class ToUint8:
+    """Round-then-clip to uint8 (identity on uint8 input) — the wire
+    dtype contract of the split pipeline's host stage; matches the
+    tf.data twin's ``tf.round`` + cast and PIL's own quantization."""
+
+    def __call__(self, rng, image):
+        if image.dtype == np.uint8:
+            return image
+        return np.clip(np.round(image), 0, 255).astype(np.uint8)
+
+
 class Normalize:
     def __init__(self, mean, std):
         self.mean = np.asarray(mean, np.float32)
@@ -171,4 +207,25 @@ def imagenet_eval_transform(size: int = 224) -> Compose:
         CenterCrop(size),
         ToFloat(),
         Normalize((0.485, 0.456, 0.406), (0.229, 0.224, 0.225)),
+    ])
+
+
+def imagenet_host_transform(size: int = 224) -> Compose:
+    """HOST STAGE of the split pipeline, numpy twin: decode-side work
+    only — resize the shorter side and center-crop the fixed square
+    **canvas** (``_resize_min(size)``², uint8 HWC). Everything
+    stochastic (random ``size``² crop, flip, jitter, normalize, mixup)
+    runs on device from this canvas
+    (``device_aug.DeviceAugment("classification", crop=size)`` — the
+    composition train.py's ``--device-aug`` builds), so the host stays
+    pure I/O and the wire carries 1-byte pixels. The tf.data twin is
+    ``imagenet.make_dataset(host_stage="canvas")``; pass this as the
+    folder dataset's transform (data/folder.py) for the same split on
+    the cv2 path, and the parity tests use it as the host-stage
+    oracle's input producer."""
+    return Compose([
+        Rescale(_resize_min(size)),
+        CenterCrop(_resize_min(size)),
+        EnsureRGB(),
+        ToUint8(),
     ])

@@ -24,7 +24,8 @@ from pathlib import Path
 # the frac is wait/(wait+step) — >0.5 means the run is input-bound,
 # not chip-bound.
 _INPUT_WAIT_KEYS = ("host_wait_ms", "shard_ms", "h2d_wait_ms",
-                    "step_ms", "input_wait_frac")
+                    "step_ms", "input_wait_frac",
+                    "h2d_bytes_per_image")
 
 
 def input_wait_metrics(summary: dict, prefix: str = "input_") -> dict:

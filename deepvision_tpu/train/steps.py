@@ -32,9 +32,25 @@ def classification_train_step(
     ``normalize_kind`` must match the host pipeline's uint8 wire contract:
     "imagenet" (TF-lineage mean subtraction) or "torch" (PT-lineage
     mean/std — configs with ``augment: "pt"``); bind it with
-    ``functools.partial`` before compiling."""
+    ``functools.partial`` before compiling.
+
+    Mixup (``data/device_aug.py``, device-side): when the in-step
+    augmentation mixed the images it adds ``label_b`` (the partner
+    permutation's labels) and ``lam`` to the batch, and the loss becomes
+    the standard convex pair ``lam*CE(y) + (1-lam)*CE(y_b)`` (Zhang et
+    al. 2018); top-k accuracy stays against the primary labels. The
+    keys are present-or-absent per CONFIG (never per batch), so there is
+    no retrace churn."""
     images = maybe_normalize(batch["image"], normalize_kind)
     labels = batch["label"]
+    labels_b, lam = batch.get("label_b"), batch.get("lam")
+
+    def mixed_ce(logits):
+        loss = softmax_cross_entropy(logits, labels)
+        if labels_b is None:
+            return loss
+        return lam * loss + (1.0 - lam) * softmax_cross_entropy(
+            logits, labels_b)
 
     def loss_fn(params):
         out, mutated = state.apply_fn(
@@ -49,13 +65,13 @@ def classification_train_step(
         # (ref: Inception/pytorch/train.py aux handling, models/inception_v1.py:92-113).
         if isinstance(out, (tuple, list)):
             main, *aux = out
-            loss = softmax_cross_entropy(main, labels)
+            loss = mixed_ce(main)
             for a in aux:
-                loss = loss + 0.3 * softmax_cross_entropy(a, labels)
+                loss = loss + 0.3 * mixed_ce(a)
             logits = main
         else:
             logits = out
-            loss = softmax_cross_entropy(logits, labels)
+            loss = mixed_ce(logits)
         return loss, (logits, mutated.get("batch_stats", state.batch_stats))
 
     (loss, (logits, new_bs)), grads = jax.value_and_grad(

@@ -207,6 +207,10 @@ _SLOW_TESTS = {
     # TWICE to convergence (8 epochs each) for the fault-free-parity
     # pin; the per-fault chaos matrix stays in the fast tier
     "test_composed_chaos_matches_fault_free",
+    # device-aug (ISSUE 7): full-geometry (256² canvas) host-vs-device
+    # parity pin; the op-by-op parity tests stay in the fast tier on
+    # 16² canvases
+    "test_full_pipeline_parity_host_vs_device_slow",
 }
 # whole modules that spawn real subprocesses (jax.distributed workers)
 _SLOW_MODULES = {"test_distributed"}

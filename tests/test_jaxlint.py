@@ -850,6 +850,94 @@ def test_load_config_reads_loop_sleep_funcs(tmp_path):
     assert "*probe*" in LintConfig().loop_sleep_funcs
 
 
+# ----------------------------------------------------------- JX114
+
+
+def test_jx114_flags_f32_cast_feeding_the_wire(tmp_path):
+    r = lint(tmp_path, "lib/feed.py", """
+        import numpy as np
+        import jax
+
+        def feed_batches(mesh, batches):
+            for b in batches:
+                img = b["image"].astype(np.float32) / 255.0
+                yield jax.device_put(img)               # assigned name
+
+        def feed_direct(mesh, b):
+            return jax.device_put(b["image"].astype(np.float32))
+
+        def feed_dict(mesh, raw, shard_batch):
+            batch = {"image": np.asarray(raw, np.float32)}
+            return shard_batch(mesh, batch)             # dict literal
+        """)
+    assert codes(r) == ["JX114", "JX114", "JX114"]
+    assert "uint8" in r.findings[0].message
+    assert "normalize on device" in r.findings[0].message
+
+
+def test_jx114_passes_uint8_wire_and_castless_paths(tmp_path):
+    r = lint(tmp_path, "lib/feed.py", """
+        import numpy as np
+        import jax
+
+        def feed_uint8(mesh, batches):
+            for b in batches:
+                yield jax.device_put(b["image"])        # uint8 stays
+
+        def host_only_normalize(b):
+            # f32 cast with NO wire call in sight: host tooling, fine
+            return b["image"].astype(np.float32) / 255.0
+
+        def feed_after_the_fact(mesh, b):
+            out = jax.device_put(b["image"])            # wire FIRST...
+            img = np.asarray(b["image"], np.float32)    # ...cast later
+            return out, img
+
+        def labels_unflagged(mesh, b):
+            # int32 labels are not an f32 cast; boxes stay f32 by
+            # contract and carry no cast here either
+            return jax.device_put({"label": b["label"].astype(np.int32),
+                                   "boxes": b["boxes"]})
+
+        def clean_reassign(mesh, b):
+            img = b["image"].astype(np.float32)   # host-side stats only
+            stats = img.mean()
+            img = b["image"]                      # taint cleared here
+            return jax.device_put(img), stats
+        """)
+    assert codes(r) == []
+
+
+def test_jx114_wire_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(wire_funcs=["my_wire"])
+    r = lint(tmp_path, "lib/feed.py", """
+        import numpy as np
+        import jax
+
+        def a(mesh, b, my_wire):
+            return my_wire(b["image"].astype(np.float32))   # matched
+
+        def c(mesh, b):
+            return jax.device_put(b["image"].astype(np.float32))  # not
+        """, cfg=cfg)
+    assert codes(r) == ["JX114"]
+
+
+def test_load_config_reads_wire_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        wire_funcs = ["my_wire"]
+        """))
+    cfg = load_config(p)
+    assert cfg.wire_funcs == ["my_wire"]
+    # defaults cover the repo's wire sinks
+    for name in ("device_put", "shard_batch", "DevicePrefetcher"):
+        assert name in LintConfig().wire_funcs
+
+
 # ------------------------------------------- suppression + baseline
 
 

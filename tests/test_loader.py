@@ -1,0 +1,132 @@
+"""Multi-process host decode (data/loader.py): deterministic
+round-robin merge, error propagation, and bounded shutdown. Factories
+are module-level classes — the spawn pickling contract the real
+ImageNet factory (data/imagenet._TrainShardFactory) rides on."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.data.loader import (
+    MultiProcessLoader,
+    WorkerError,
+    mp_batches,
+)
+
+
+class TaggedFactory:
+    """Yields ``per_worker`` batches tagged (worker_id, index)."""
+
+    def __init__(self, per_worker: int):
+        self.per_worker = per_worker
+
+    def __call__(self, worker_id: int, num_workers: int):
+        for i in range(self.per_worker):
+            yield {"image": np.full((2, 4), worker_id * 100 + i,
+                                    np.int32)}
+
+
+class ExplodingFactory:
+    def __call__(self, worker_id: int, num_workers: int):
+        yield {"image": np.zeros((2, 2), np.float32)}
+        if worker_id == 1:
+            raise OSError("synthetic decode failure")
+        yield {"image": np.ones((2, 2), np.float32)}
+
+
+class UnevenFactory:
+    """Worker 0 yields 3 batches, worker 1 yields 1 — exercises the
+    rotation shrinking as workers exhaust."""
+
+    def __call__(self, worker_id: int, num_workers: int):
+        for i in range(3 if worker_id == 0 else 1):
+            yield {"image": np.full((1,), worker_id * 10 + i, np.int32)}
+
+
+def _tags(batches):
+    return [int(b["image"].ravel()[0]) for b in batches]
+
+
+def test_round_robin_merge_is_deterministic():
+    runs = []
+    for _ in range(2):
+        with MultiProcessLoader(TaggedFactory(3), 2) as loader:
+            runs.append(_tags(loader))
+    # strict w0,w1 interleave, identical across runs
+    assert runs[0] == [0, 100, 1, 101, 2, 102]
+    assert runs[0] == runs[1]
+
+
+def test_uneven_workers_drain_in_order():
+    with MultiProcessLoader(UnevenFactory(), 2) as loader:
+        assert _tags(loader) == [0, 10, 1, 2]
+
+
+def test_worker_exception_reraises_with_traceback():
+    with MultiProcessLoader(ExplodingFactory(), 2) as loader:
+        with pytest.raises(WorkerError, match="synthetic decode failure"):
+            list(loader)
+
+
+def test_mp_batches_limit_closes_pool():
+    gen = mp_batches(TaggedFactory(50), 2, limit=4)
+    got = _tags(gen)
+    assert got == [0, 100, 1, 101]
+    # generator exhausted -> pool closed; a second pull just stops
+    assert list(gen) == []
+
+
+def test_single_worker_matches_serial_order():
+    with MultiProcessLoader(TaggedFactory(4), 1) as loader:
+        assert _tags(loader) == [0, 1, 2, 3]
+
+
+def test_worker_count_validation():
+    with pytest.raises(ValueError, match="at least 1"):
+        MultiProcessLoader(TaggedFactory(1), 0)
+
+
+class TupleFactory:
+    """Non-dict batches: must ride the pickle fallback, not shm."""
+
+    def __call__(self, worker_id: int, num_workers: int):
+        for i in range(2):
+            yield (worker_id, np.full((3,), i, np.int32))
+
+
+def test_non_dict_batches_use_pickle_fallback():
+    with MultiProcessLoader(TupleFactory(), 2) as loader:
+        got = list(loader)
+    assert [(w, int(a[0])) for w, a in got] == [(0, 0), (1, 0),
+                                               (0, 1), (1, 1)]
+
+
+class GrowingFactory:
+    """Batch 2 outgrows the ring slot capacity (first batch * 1.5) —
+    oversize batches must fall back to pickling mid-stream."""
+
+    def __call__(self, worker_id: int, num_workers: int):
+        yield {"image": np.zeros((4, 4), np.float32)}
+        yield {"image": np.ones((64, 64), np.float32)}
+
+
+def test_oversize_batch_falls_back_to_pickle():
+    with MultiProcessLoader(GrowingFactory(), 1) as loader:
+        small, big = list(loader)
+    assert small["image"].shape == (4, 4)
+    assert big["image"].shape == (64, 64)
+    np.testing.assert_array_equal(big["image"], 1.0)
+
+
+def test_shm_ring_is_unlinked_on_close():
+    """The parent owns shm cleanup: after close() no loader segment
+    survives in /dev/shm (the worker's tracker is detached, so leaks
+    here would be permanent)."""
+    import glob
+
+    before = set(glob.glob("/dev/shm/psm_*"))
+    loader = MultiProcessLoader(TaggedFactory(10), 2)
+    next(iter(loader))  # rings exist now
+    loader.close()
+    assert set(glob.glob("/dev/shm/psm_*")) <= before

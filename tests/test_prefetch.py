@@ -232,7 +232,7 @@ def test_input_wait_metrics_naming():
     m = input_wait_metrics(tel.summary())
     assert set(m) == {"input_host_wait_ms", "input_shard_ms",
                       "input_h2d_wait_ms", "input_step_ms",
-                      "input_wait_frac"}
+                      "input_wait_frac", "input_h2d_bytes_per_image"}
     assert m["input_h2d_wait_ms"] == pytest.approx(30.0)
     assert m["input_wait_frac"] == pytest.approx(0.75)
 

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""feed-smoke: the `make check` input-pipeline gate (ISSUE 7).
+
+Three assertions, all on MEASURED values from the real record readers
+and the real prefetcher — the contract the split pipeline ships on:
+
+1. **wire dtype**: the split pipeline's host stage
+   (``make_dataset(host_stage="crop")``) delivers uint8 pixels to the
+   prefetcher, and the prefetcher's wire accounting
+   (``FeedTelemetry.record_wire``) sees ``uint8`` crossing H2D;
+2. **byte win**: ``h2d_bytes_per_image`` of the uint8 wire is >= 3.9x
+   smaller than the f32 reference-parity path's, measured on the same
+   records at the same geometry (224² + int32 label: 3.9998x);
+3. **parity**: host f32 augmentation (numpy transforms twins) and the
+   device stage (``data/device_aug.py``) agree at pinned tolerance on
+   SHARED explicit decisions — same crops, same flips, same jitter
+   factors — after on-device normalization (<=1 uint8 LSB of jitter
+   rounding, i.e. ~0.018 in torch-normalized units).
+
+Runs on CPU in ~30s (tiny self-built JPEG record set, cached in /tmp).
+Exit 0 + a grep-stable ``feed-smoke OK`` line, or an AssertionError.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+if __package__ in (None, ""):
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = Path("/tmp/dvt_feed_smoke")
+N_IMAGES, SHARDS, BATCH = 48, 2, 16
+SIZE = 224
+
+
+def _ensure_records() -> None:
+    done = ROOT / "COMPLETE"
+    if done.exists():
+        return
+    import tensorflow as tf
+
+    tf.config.set_visible_devices([], "GPU")
+    from deepvision_tpu.data.tfrecord import encode_example, write_records
+
+    ROOT.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(0)
+    per = N_IMAGES // SHARDS
+    for s in range(SHARDS):
+        records = []
+        for _ in range(per):
+            img = rng.integers(0, 255, (256, 256, 3), np.uint8)
+            data = tf.io.encode_jpeg(tf.constant(img)).numpy()
+            records.append(encode_example({
+                "image/encoded": [data],
+                "image/class/label": [int(rng.integers(1, 1001))],
+            }))
+        write_records(ROOT / f"train-{s:05d}-of-{SHARDS:05d}", records)
+    done.touch()
+
+
+def _wire_bytes(host_stage: str | None, as_uint8: bool) -> tuple:
+    """Drain 2 batches of a reader config through the REAL prefetcher;
+    -> (wire_dtype, h2d_bytes_per_image)."""
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.data.imagenet import make_dataset
+    from deepvision_tpu.data.prefetch import DevicePrefetcher, FeedTelemetry
+
+    mesh = create_mesh(1, 1)
+    ds = make_dataset(str(ROOT / "train-*"), BATCH, SIZE,
+                      is_training=True, as_uint8=as_uint8, seed=0,
+                      host_stage=host_stage)
+    it = ds.as_numpy_iterator()
+
+    def batches():
+        for _ in range(2):
+            img, lbl = next(it)
+            yield {"image": img, "label": lbl}
+
+    tel = FeedTelemetry()
+    for _ in DevicePrefetcher(batches(), mesh, telemetry=tel):
+        pass
+    return tel.wire_dtype, tel.h2d_bytes_per_image
+
+
+def _parity_gap() -> float:
+    """Max |host f32 aug - device aug| in torch-normalized units, on
+    shared explicit decisions (the tests' oracle, end to end)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu.data import transforms as T
+    from deepvision_tpu.data import device_aug as A
+    from deepvision_tpu.ops.normalize import maybe_normalize
+
+    rng = np.random.default_rng(1)
+    canvas = rng.integers(0, 256, (4, 64, 64, 3), np.uint8)
+    key = jax.random.key(3)
+    kc, kf, kj = jax.random.split(key, 3)
+    tops, lefts = A.crop_params(kc, 4, 64, 64, 48)
+    flips = A.flip_params(kf, 4)
+    fb, fc, fs = A.jitter_params(kj, 4, 0.4, 0.4, 0.4)
+
+    dev = A.crop(jnp.asarray(canvas), tops, lefts, 48)
+    dev = A.flip(dev, flips)
+    dev = A.color_jitter(dev, fb, fc, fs)
+    dev = np.asarray(maybe_normalize(dev, "torch"))
+    assert dev.dtype == np.float32
+
+    norm = T.Normalize((0.485, 0.456, 0.406), (0.229, 0.224, 0.225))
+    gap = 0.0
+    for i in range(4):
+        t, l = int(tops[i]), int(lefts[i])
+        host = canvas[i, t:t + 48, l:l + 48]
+        if bool(flips[i]):
+            host = host[:, ::-1]
+        host = T.apply_color_jitter(host.astype(np.float32),
+                                    float(fb[i]), float(fc[i]),
+                                    float(fs[i]))
+        host = np.clip(np.round(host), 0, 255).astype(np.uint8)
+        host = norm(rng, T.ToFloat()(rng, host))
+        gap = max(gap, float(np.abs(dev[i] - host).max()))
+    return gap
+
+
+def main() -> int:
+    _ensure_records()
+
+    f32_dtype, f32_bytes = _wire_bytes(host_stage=None, as_uint8=False)
+    u8_dtype, u8_bytes = _wire_bytes(host_stage="crop", as_uint8=True)
+    assert u8_dtype == "uint8", \
+        f"split-pipeline wire dtype is {u8_dtype!r}, want uint8"
+    assert f32_dtype == "float32", \
+        f"f32 comparator wire dtype is {f32_dtype!r}"
+    ratio = f32_bytes / u8_bytes
+    assert ratio >= 3.9, \
+        f"h2d bytes/image only {ratio:.2f}x smaller (<3.9x): " \
+        f"f32={f32_bytes:.0f} uint8={u8_bytes:.0f}"
+
+    # 2 uint8 LSB in normalized units: 1 LSB of jitter rounding skew +
+    # 1 LSB of f32-accumulation-order headroom, / 255 / min std 0.225
+    gap = _parity_gap()
+    tol = 2.0 / 255.0 / 0.225
+    assert gap <= tol, \
+        f"host-vs-device augmentation parity gap {gap:.4f} > {tol:.4f}"
+
+    print(f"feed-smoke OK (wire_dtype=uint8, "
+          f"h2d_bytes_per_image {f32_bytes:.0f} -> {u8_bytes:.0f} "
+          f"= {ratio:.2f}x, parity_gap={gap:.4f} <= {tol:.4f})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

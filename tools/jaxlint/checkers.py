@@ -1043,3 +1043,110 @@ class LoopSleepChecker(Checker):
                                 "blocks until the sleep expires; use "
                                 "the loop's stop Event.wait(timeout) "
                                 "(stop-responsive backoff, PR 4 idiom)")
+
+
+_WIRE_DEFAULT_NOTE = "see LintConfig.wire_funcs"
+
+
+@register_checker
+class F32WireChecker(Checker):
+    """Host-side f32 pixel materialization feeding the device wire:
+    ``x.astype(np.float32)`` (or ``np.asarray(x, np.float32)``) whose
+    result flows into ``device_put``/``shard_batch``/the prefetcher
+    ships 4-byte pixels over the H2D link — the exact hazard BENCH_r04
+    measured as a 7x input bind (0.073 GB/s link = ~483 uint8 img/s,
+    ~121 f32 img/s). The pipeline contract is: the host ships uint8
+    HWC; normalization (and augmentation) runs inside the compiled
+    step (``ops/normalize.maybe_normalize``, ``data/device_aug.py``).
+    Which call names count as wire sinks is the ``wire_funcs`` knob
+    (``jaxlint.toml``); non-image small tensors (labels, boxes) are
+    cheap either way, but an f32 CAST feeding the wire is the
+    tell-tale of a pipeline normalizing on the host."""
+
+    code = "JX114"
+    name = "f32-pixels-on-the-wire"
+    description = ("host-side .astype(np.float32)/np.asarray(x, f32) "
+                   "result fed to device_put/shard_batch/prefetcher "
+                   "(4x wire bytes; ship uint8, normalize on device)")
+
+    _CAST_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                   "numpy.array"}
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        wire = set(mod.cfg.wire_funcs)
+        for info in mod.functions:
+            if info.parent is not None:
+                continue  # nested defs scan with their parent
+            yield from self._scan(mod, info.node, wire)
+
+    def _scan(self, mod: ModuleContext, func: FunctionNode,
+              wire: set) -> Iterator[Finding]:
+        from tools.jaxlint.core import assign_target_names
+
+        # per-name assignment history (line, came-from-an-f32-cast):
+        # a name is tainted AT a use site iff its LATEST assignment
+        # before that line contained a cast — a clean reassignment
+        # (img = batch["image"]) clears the taint for later uses
+        assigns: dict[str, list] = {}
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and getattr(node, "value", None) is not None:
+                cast = self._has_f32_cast(node.value)
+                for name in assign_target_names(node):
+                    assigns.setdefault(name, []).append(
+                        (node.lineno, cast))
+
+        def tainted_at(name: str, line: int) -> bool:
+            last = None
+            for lno, cast in assigns.get(name, ()):
+                if lno < line and (last is None or lno > last[0]):
+                    last = (lno, cast)
+            return bool(last and last[1])
+
+        flagged: set[int] = set()
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call) or id(node) in flagged:
+                continue
+            la = last_attr(call_name(node))
+            if la not in wire:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                direct = self._has_f32_cast(arg)
+                via_name = any(
+                    isinstance(sub, ast.Name)
+                    and tainted_at(sub.id, node.lineno)
+                    for sub in ast.walk(arg))
+                if direct or via_name:
+                    flagged.add(id(node))
+                    yield mod.finding(
+                        node, self.code,
+                        f"'{call_name(node)}' ships a host-side "
+                        "float32 cast over the H2D wire (4 bytes/"
+                        "pixel); ship uint8 and normalize on device "
+                        "(ops/normalize.maybe_normalize + "
+                        "data/device_aug.py)")
+                    break
+
+    def _has_f32_cast(self, expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" \
+                    and node.args \
+                    and self._is_f32(node.args[0]):
+                return True
+            if call_name(node) in self._CAST_CALLS:
+                vals = list(node.args[1:]) + [
+                    k.value for k in node.keywords if k.arg == "dtype"]
+                if any(self._is_f32(v) for v in vals):
+                    return True
+        return False
+
+    @staticmethod
+    def _is_f32(node: ast.AST) -> bool:
+        try:
+            text = ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse is 3.9+
+            return False
+        return "float32" in text

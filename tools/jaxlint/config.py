@@ -275,6 +275,15 @@ class LintConfig:
         "*supervise*", "*dispatch*", "*router*", "*probe*",
         "*autoscale*", "*respawn*", "*_loop*", "*watchdog*",
     ])
+    # Call names treated as host->device wire sinks (JX114): a host
+    # f32 cast feeding one of these ships 4-byte pixels over the H2D
+    # link — the input-wall hazard ISSUE 7 removed (uint8 wire +
+    # on-device normalize, ops/normalize.py + data/device_aug.py).
+    wire_funcs: list[str] = field(default_factory=lambda: [
+        "device_put", "shard_batch", "shard_by_process",
+        "DevicePrefetcher", "device_prefetch",
+        "make_array_from_process_local_data",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -294,7 +303,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "traced_name_patterns", "jit_wrappers", "static_return_calls",
         "key_fresheners", "key_name_patterns", "constraint_funcs",
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
-        "timed_funcs", "loop_sleep_funcs", "disable",
+        "timed_funcs", "loop_sleep_funcs", "wire_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

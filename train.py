@@ -153,6 +153,25 @@ def parse_args():
     p.add_argument("--profile-dir", default=None,
                    help="where --profile-steps writes the profiler "
                         "trace (default: WORKDIR/MODEL/profile)")
+    p.add_argument("--device-aug", action="store_true",
+                   help="split input pipeline (data/device_aug.py): the "
+                        "host stops at decode+resize and ships uint8; "
+                        "crop/flip/jitter/normalize run INSIDE the "
+                        "compiled step, keyed through KeySeq so "
+                        "preemption/chaos bit-determinism holds. "
+                        "Record-backed runs only (--data-dir imagenet/"
+                        "detection/pose/cyclegan)")
+    p.add_argument("--mixup", type=float, default=0.0, metavar="ALPHA",
+                   help="device-side mixup (Zhang et al. 2018) with "
+                        "Beta(ALPHA, ALPHA) mixing, fused into the step "
+                        "(classification configs, requires "
+                        "--device-aug); 0 = off")
+    p.add_argument("--loader-workers", type=int, default=1,
+                   help="spread the host decode stage over N spawned "
+                        "processes (data/loader.py; deterministic "
+                        "round-robin merge over disjoint file shards) — "
+                        "the multi-core answer to a decode-bound host; "
+                        "ImageNet record runs only")
     p.add_argument("--prefetch-depth", type=int, default=2,
                    help="device batches the async feed keeps in flight "
                         "ahead of the step (data/prefetch.py); 1 = "
@@ -217,6 +236,32 @@ def main():
     if args.lr_rewarm is not None and not args.recover:
         raise SystemExit("--lr-rewarm only applies with --recover "
                          "(it scales the LR on each rollback)")
+    if args.loader_workers < 1:
+        raise SystemExit(
+            f"--loader-workers must be >= 1, got {args.loader_workers}")
+    if args.loader_workers > 1 and not (
+            args.data_dir and cfg["dataset"] == "imagenet"):
+        raise SystemExit(
+            "--loader-workers parallelizes the record decode stage — "
+            "--data-dir ImageNet configs only (this run: "
+            f"dataset={cfg['dataset']!r}, data_dir={args.data_dir!r})")
+    if args.device_aug and (
+            not args.data_dir
+            or cfg["dataset"] not in ("imagenet", "detection", "pose",
+                                      "gan_unpaired")):
+        raise SystemExit(
+            "--device-aug splits a record-backed host pipeline — "
+            "--data-dir imagenet/detection/pose/cyclegan configs only "
+            f"(this run: dataset={cfg['dataset']!r}, "
+            f"data_dir={args.data_dir!r})")
+    if args.mixup and not (args.device_aug
+                           and cfg["dataset"] == "imagenet"):
+        raise SystemExit(
+            "--mixup is a device-side classification augmentation; it "
+            "requires --device-aug on a --data-dir ImageNet config "
+            f"(this run: {args.model!r})")
+    if args.mixup < 0:
+        raise SystemExit(f"--mixup must be >= 0, got {args.mixup}")
     _maybe_enable_trace(args)
     if cfg["dataset"].startswith("gan"):
         if args.recover or args.faults:
@@ -253,7 +298,7 @@ def main():
             steps = args.steps_per_epoch or 22245 // cfg["batch_size"]  # MPII
             train_data, val_data, steps = make_pose_data(
                 args.data_dir, cfg["batch_size"], size,
-                steps_per_epoch=steps,
+                steps_per_epoch=steps, device_aug=args.device_aug,
             )
         else:
             from deepvision_tpu.data.pose import (
@@ -296,7 +341,7 @@ def main():
             steps = args.steps_per_epoch or 2501 // cfg["batch_size"]  # VOC07
             train_data, val_data, steps = make_detection_data(
                 args.data_dir, cfg["batch_size"], size,
-                steps_per_epoch=steps,
+                steps_per_epoch=steps, device_aug=args.device_aug,
             )
         else:
             from deepvision_tpu.data.detection import (
@@ -329,6 +374,8 @@ def main():
             augment=cfg.get("augment", "tf"),
             use_raw=args.use_raw,
             steps_per_epoch=args.steps_per_epoch,
+            device_aug=args.device_aug,
+            loader_workers=args.loader_workers,
         )
     elif args.data_dir and cfg["dataset"] == "mnist":
         import os
@@ -383,6 +430,47 @@ def main():
             "eval_step": partial(classification_eval_step,
                                  normalize_kind="torch"),
         }
+
+    if args.device_aug:
+        # device stage of the split pipeline: the host shipped
+        # decode-stage uint8 (the make_*_data device_aug flags above);
+        # the stochastic ops run INSIDE the compiled step, keyed
+        # through the step's KeySeq subkey (bit-deterministic resume).
+        # Detection/pose flips transform boxes/keypoints consistently;
+        # eval steps stay unwrapped (validation has no augmentation).
+        from deepvision_tpu.data.device_aug import (
+            MPII_FLIP_PERM,
+            DeviceAugment,
+            augment_step,
+        )
+        from deepvision_tpu.data.imagenet import PT_JITTER
+
+        if cfg["dataset"] == "detection":
+            aug = DeviceAugment("detection", flip=True)
+        elif cfg["dataset"] == "pose":
+            aug = DeviceAugment(
+                "pose", flip=True,
+                # the left/right channel swap is defined by the MPII
+                # joint order; reduced-joint synthetic configs have no
+                # left/right semantics to swap
+                flip_pairs=(MPII_FLIP_PERM
+                            if cfg["num_heatmaps"] == 16 else None))
+        else:  # imagenet classification
+            aug = DeviceAugment(
+                "classification", flip=True,
+                jitter=(PT_JITTER if cfg.get("augment") == "pt"
+                        else 0.0),
+                mixup=args.mixup)
+        if not step_fns:
+            from deepvision_tpu.train.steps import (
+                classification_train_step as _cls_train,
+            )
+
+            step_fns = {"train_step": _cls_train}
+        step_fns["train_step"] = augment_step(step_fns["train_step"],
+                                              aug)
+        print(f"[device-aug] {aug} fused into the train step",
+              flush=True)
 
     if args.steps_per_epoch:
         steps = args.steps_per_epoch
@@ -576,7 +664,8 @@ def run_gan(args, cfg, dtype):
 
             steps = args.steps_per_epoch or 1000 // bs
             train_data = make_cyclegan_data(
-                args.data_dir, bs, size, steps_per_epoch=steps
+                args.data_dir, bs, size, steps_per_epoch=steps,
+                device_aug=args.device_aug,
             )
         else:
             from deepvision_tpu.data.gan import synthetic_unpaired
@@ -601,6 +690,22 @@ def run_gan(args, cfg, dtype):
             beta1=cfg["optimizer_params"]["beta1"],
         )
         step_fn = cyclegan_train_step
+        if args.device_aug:
+            # split pipeline, GAN flavor: the host ships the uint8
+            # size+30 canvas (data/gan.py device_aug); crop/flip and
+            # the [-1,1] scale fuse into the compiled two-phase step
+            # (the GAN steps don't call maybe_normalize themselves, so
+            # the augment carries normalize="tanh")
+            from deepvision_tpu.data.device_aug import (
+                DeviceAugment,
+                augment_step,
+            )
+
+            aug = DeviceAugment("gan", crop=size, flip=True,
+                                normalize="tanh")
+            step_fn = augment_step(step_fn, aug)
+            print(f"[device-aug] {aug} fused into the train step",
+                  flush=True)
 
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     # SIGTERM -> stop at the next epoch boundary with an off-cadence save

@@ -20,7 +20,13 @@ Mechanics (SURVEY §5.8's DCN mapping):
 - each process feeds only its own file shard
   (``make_dataset(num_process=, process_index=)``), pushed through its
   own async device-feed thread (``data/prefetch.py`` — per-process
-  prefetch + overlapped H2D), and ``core.shard_batch`` assembles
+  prefetch + overlapped H2D). The split-pipeline flags pass straight
+  through to train.py: ``--device-aug`` ships decode-stage uint8 and
+  fuses crop/flip/jitter/normalize into the compiled step
+  (``data/device_aug.py`` — 4x less DCN/PCIe wire traffic per host),
+  and ``--loader-workers N`` spreads each process's decode stage over
+  N spawned sub-workers (``data/loader.py``; the file-shard contract
+  composes: process shard x worker shard). ``core.shard_batch`` assembles
   per-process local arrays into global jax.Arrays
   (``jax.make_array_from_process_local_data``). Multi-host runs default
   to ``--prefetch-depth 3`` (one extra in-flight batch) because the
