@@ -47,7 +47,7 @@ smoke:
 # (tools/jaxlint/; suppressions + baseline in jaxlint.toml). Seconds-
 # cheap, runs on every PR via `make check`.
 lint:
-	$(PY) -m tools.jaxlint deepvision_tpu/
+	$(PY) -m tools.jaxlint deepvision_tpu/ train_dist.py
 	$(PY) -m tools.jaxlint.evalcheck
 
 # serving smoke: boot the stdin-JSONL server on lenet5 (compiles its
@@ -132,11 +132,32 @@ chaos-smoke:
 	grep -q "rollbacks=1 ckpt_fallbacks=1 data_retries=2" "$$L" && \
 	echo "chaos-smoke OK (recovered: rollback + ckpt fallback + retries)"
 
+# distributed chaos smoke: a REAL 2-process jax.distributed CPU cluster
+# (lenet synthetic) under the supervisor; host_preempt@8 SIGTERMs one
+# host mid-job, the hosts commit a coordinated checkpoint (or exit
+# after the epoch save when the barrier lands past the epoch end —
+# both are coordinated), and the job relaunches on the surviving host
+# with deterministic elastic resume. Asserts the grep-stable
+# `[cluster] preemptions=1 resumes=1` exit line + exit 0: the
+# `make check` multi-host-availability gate (resilience/cluster.py)
+chaos-dist-smoke:
+	@mkdir -p logs; L="logs/chaos-dist-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	rm -rf runs/chaos-dist-smoke; \
+	$(PY) train_dist.py --supervise 2 --platform cpu \
+		--barrier-lead 3 --barrier-timeout-s 60 \
+		--straggler-after-s 30 --heartbeat-timeout-s 240 \
+		--init-timeout-s 120 --faults host_preempt@14 \
+		-m lenet5 --epochs 2 --synthetic-size 1024 --batch-size 64 \
+		--steps-per-epoch 12 --workdir runs/chaos-dist-smoke 2>&1 | tee "$$L" && \
+	grep -qE "\[cluster\] preemptions=1 resumes=1" "$$L" && \
+	grep -q "hosts=1/2" "$$L" && \
+	echo "chaos-dist-smoke OK (coordinated preempt + elastic resume on the survivor)"
+
 # the default CI path: hazard lint + serving smoke + chaos smoke +
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke router-smoke obs-smoke chaos-smoke feed-smoke
+check: lint serve-smoke router-smoke obs-smoke chaos-smoke chaos-dist-smoke feed-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -260,4 +281,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint check serve-smoke router-smoke obs-smoke feed-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint check serve-smoke router-smoke obs-smoke feed-smoke chaos-dist-smoke bench dryrun tensorboard find-python list-models rehearsal

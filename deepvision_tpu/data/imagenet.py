@@ -406,6 +406,7 @@ def make_imagenet_data(
     train_as_uint8: bool = True, augment: str = "tf",
     use_raw: bool | None = None, steps_per_epoch: int | None = None,
     device_aug: bool = False, loader_workers: int = 1,
+    max_worker_restarts: int = 0, fault_injector=None,
 ):
     """-> (train_data(epoch)->iter, val_data()->iter, steps_per_epoch).
 
@@ -498,7 +499,12 @@ def make_imagenet_data(
                 seed=epoch, base_shards=nproc, base_index=pid,
                 host_stage=host_stage, as_uint8=train_as_uint8,
                 stored=raw_stored)
-            return mp_batches(factory, loader_workers, steps)
+            # max_worker_restarts/fault_injector: bounded respawn of a
+            # dead decode worker at its shard position + the
+            # worker_kill chaos site (data/loader.py)
+            return mp_batches(factory, loader_workers, steps,
+                              max_restarts=max_worker_restarts,
+                              fault_injector=fault_injector)
         if have_raw:
             ds = make_raw_dataset(str(d / "raw-train-*"), local_bs, size,
                                   is_training=True, stored=raw_stored,

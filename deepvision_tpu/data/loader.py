@@ -158,8 +158,12 @@ def _split_batch(batch):
 
 
 def _worker_main(factory, worker_id: int, num_workers: int, queue,
-                 free_q, stop, depth: int) -> None:
-    """Child entry point (module-level: must be picklable for spawn)."""
+                 free_q, stop, depth: int, skip: int = 0) -> None:
+    """Child entry point (module-level: must be picklable for spawn).
+    ``skip`` > 0 is a RESPAWN resuming a dead worker at its shard
+    position: the factory stream is deterministic, so skipping the
+    batches the parent already merged replays the incarnation to
+    exactly where its predecessor died."""
     _untrack_shm()
     ring = None
     ring_sent = False
@@ -197,7 +201,10 @@ def _worker_main(factory, worker_id: int, num_workers: int, queue,
                        ring.dump(idx, arrays), extras))
 
     try:
-        for batch in factory(worker_id, num_workers):
+        stream = factory(worker_id, num_workers)
+        if skip:
+            stream = islice(stream, skip, None)
+        for batch in stream:
             encoded = encode(batch)
             if encoded is None or not put((_BATCH, encoded)):
                 return
@@ -222,14 +229,42 @@ class MultiProcessLoader:
     """Iterator over the round-robin merge of ``num_workers`` spawned
     factory streams; ``depth`` bounds each worker's ready-batch queue
     (host-memory backpressure, same contract as the device prefetcher's
-    ``depth``)."""
+    ``depth``).
+
+    ``max_restarts`` > 0 turns a dead worker (SIGKILL/OOM, torn pipe,
+    or a factory exception) from an epoch-fatal :class:`WorkerError`
+    into bounded self-healing: the worker is respawned resuming at its
+    shard position (``skip`` = batches the parent already merged from
+    it, deterministic factory replay), the round-robin merge retries
+    the SAME rotation slot, so the merged stream is byte-identical to
+    an undisturbed run. Each restart counts into the obs registry
+    (``loader_worker_restarts``); ``max_restarts`` CONSECUTIVE deaths
+    of one worker without a delivered batch in between fail fast — a
+    deterministic fault (bad shard, systematic decode error) replays
+    to the same death and must still kill the run loudly.
+    ``fault_injector`` consults the ``worker_kill`` chaos site once per
+    merged batch (``resilience/faults.py``)."""
 
     def __init__(self, factory: Callable, num_workers: int, *,
-                 depth: int = 2):
+                 depth: int = 2, max_restarts: int = 0,
+                 fault_injector=None):
         if num_workers < 1:
             raise ValueError(
                 f"need at least 1 worker, got {num_workers}")
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts}")
         ctx = mp.get_context("spawn")
+        self._ctx = ctx
+        self._factory = factory
+        self._num_workers = num_workers
+        self._depth = depth
+        self._max_restarts = int(max_restarts)
+        self._injector = fault_injector
+        from deepvision_tpu.obs.metrics import default_registry
+
+        self._restarts = default_registry().counter(
+            "loader_worker_restarts")
         self._stop = ctx.Event()
         self._queues = [ctx.Queue(maxsize=depth)
                         for _ in range(num_workers)]
@@ -249,6 +284,8 @@ class MultiProcessLoader:
             p.start()
         self._live = list(range(num_workers))
         self._cursor = 0
+        self._consumed = [0] * num_workers  # batches merged per worker
+        self._deaths = [0] * num_workers    # consecutive, reset on batch
         self._closed = False
         self._ring_names: set = set()  # every segment any worker made
         self._segs: dict = {}          # name -> attached SharedMemory
@@ -257,10 +294,19 @@ class MultiProcessLoader:
         return self
 
     def __next__(self):
+        import os
+        import signal
+
         while self._live:
             if self._cursor >= len(self._live):
                 self._cursor = 0
             w = self._live[self._cursor]
+            if self._injector is not None \
+                    and self._injector.check_worker_kill() \
+                    and self._procs[w].is_alive():
+                print(f"[fault] SIGKILLing loader worker {w}",
+                      flush=True)
+                os.kill(self._procs[w].pid, signal.SIGKILL)
             kind, payload = self._get(w)
             if kind == _RING:
                 self._adopt_ring(payload)
@@ -268,12 +314,63 @@ class MultiProcessLoader:
             if kind == _BATCH:
                 self._cursor += 1
                 enc, body = payload
-                return self._load(w, body) if enc == _SHM else body
-            self._live.pop(self._cursor)  # done/error: drop from rotation
+                batch = self._load(w, body) if enc == _SHM else body
+                self._consumed[w] += 1
+                self._deaths[w] = 0  # a delivered batch ends the streak
+                return batch
             if kind == _ERROR:
+                if self._deaths[w] < self._max_restarts:
+                    self._respawn(w, payload)
+                    continue  # same rotation slot: merge order preserved
+                self._live.pop(self._cursor)
                 self.close()
-                raise WorkerError(payload)
+                raise WorkerError(
+                    payload if not self._deaths[w] else
+                    f"{payload}\n(gave up after {self._deaths[w]} "
+                    f"consecutive restarts of worker {w}; "
+                    f"max_restarts={self._max_restarts})")
+            self._live.pop(self._cursor)  # done: drop from rotation
         raise StopIteration
+
+    def _respawn(self, w: int, why: str) -> None:
+        """Bounded self-heal: fresh queues (a SIGKILLed child can leave
+        a torn pickle in the old pipe), fresh process resuming at the
+        shard position already merged; ring segments the dead
+        incarnation announced stay adopted and unlink at close()."""
+        self._deaths[w] += 1
+        self._restarts.inc()
+        head = why.strip().splitlines()[0] if why else "died"
+        print(f"[loader] worker {w} died ({head}); respawning at shard "
+              f"position {self._consumed[w]} "
+              f"(restart {self._deaths[w]}/{self._max_restarts})",
+              flush=True)
+        p = self._procs[w]
+        if p.is_alive():
+            p.terminate()
+        p.join(5.0)
+        for q in (self._queues[w], self._free_qs[w]):
+            try:
+                while True:
+                    msg = q.get_nowait()
+                    if isinstance(msg, tuple) and msg[0] == _RING:
+                        self._adopt_ring(msg[1])
+            except Exception:
+                pass
+            q.close()
+            q.cancel_join_thread()
+        self._queues[w] = self._ctx.Queue(maxsize=self._depth)
+        self._free_qs[w] = self._ctx.Queue(
+            maxsize=self._depth + _RING_EXTRA)
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(self._factory, w, self._num_workers, self._queues[w],
+                  self._free_qs[w], self._stop, self._depth,
+                  self._consumed[w]),
+            daemon=True,
+            name=f"host-loader-{w}r{self._deaths[w]}",
+        )
+        p.start()
+        self._procs[w] = p
 
     def _adopt_ring(self, names) -> None:
         """Adopt just-announced worker segments into THIS process's
@@ -333,6 +430,18 @@ class MultiProcessLoader:
                                 f"loader worker {w} exited uncleanly "
                                 f"(exitcode {p.exitcode}) with no "
                                 "sentinel")
+                    except Exception as e:  # torn pickle post-SIGKILL
+                        return (_ERROR,
+                                f"loader worker {w} left a torn "
+                                f"message in its pipe "
+                                f"({type(e).__name__}: {e})")
+            except Exception as e:
+                # a child killed mid-pipe-write leaves a partial pickle
+                # the parent's get() chokes on — that's a death, not a
+                # parent crash
+                return (_ERROR,
+                        f"loader worker {w} stream corrupted "
+                        f"({type(e).__name__}: {e})")
 
     def close(self, timeout: float = 5.0) -> None:
         """Idempotent: stop workers, drain queues (a child blocked on a
@@ -403,12 +512,17 @@ class MultiProcessLoader:
 
 
 def mp_batches(factory: Callable, num_workers: int,
-               limit: int | None = None, *, depth: int = 2):
+               limit: int | None = None, *, depth: int = 2,
+               max_restarts: int = 0, fault_injector=None):
     """Generator over a bounded slice of the merged worker stream that
     closes the pool on EVERY exit (exhaustion, break, GC) — the shape
     ``make_imagenet_data`` hands the Trainer: worker streams may
-    ``repeat()`` forever, the parent's ``limit`` is the epoch length."""
-    loader = MultiProcessLoader(factory, num_workers, depth=depth)
+    ``repeat()`` forever, the parent's ``limit`` is the epoch length.
+    ``max_restarts``/``fault_injector`` pass through to the loader's
+    bounded worker respawn + ``worker_kill`` chaos site."""
+    loader = MultiProcessLoader(factory, num_workers, depth=depth,
+                                max_restarts=max_restarts,
+                                fault_injector=fault_injector)
     try:
         src = loader if limit is None else islice(loader, limit)
         yield from src

@@ -21,6 +21,17 @@ fault-injection harness needed to TEST it on CPU:
                  backoff, rollback budget) + :class:`RecoveryCounters`
                  (rollbacks / ckpt_fallbacks / data_retries, surfaced
                  per epoch through ``train/loggers.Loggers``).
+- ``cluster``  : preemption-tolerant MULTI-HOST training —
+                 :class:`ClusterMember` (heartbeats + the coordinated
+                 save-barrier protocol the Trainer speaks),
+                 :class:`HostLedger` (liveness/straggler view, obs
+                 gauges), and :class:`ClusterSupervisor`
+                 (``train_dist.py --supervise N``: watch, deliver/
+                 absorb preemptions, relaunch on the surviving host
+                 set with deterministic elastic resume). Imported
+                 lazily by consumers — it is NOT re-exported here so
+                 ``import deepvision_tpu.resilience`` stays cheap for
+                 the serve/data layers.
 
 Consumers: ``train/trainer.py`` (NaN tripwire -> checkpoint rollback +
 batch-window skip), ``train/checkpoint.py`` (per-save checksum
@@ -30,12 +41,15 @@ dispatcher with crash containment + backoff restart).
 """
 
 from deepvision_tpu.resilience.faults import (
+    CLUSTER_SITES,
     FaultInjector,
     FaultSpec,
     InjectedCrash,
     InjectedIOError,
+    format_spec,
     parse_schedule,
     poison_batch,
+    split_schedule,
 )
 from deepvision_tpu.resilience.recovery import (
     NumericDivergence,
@@ -45,12 +59,15 @@ from deepvision_tpu.resilience.recovery import (
 )
 
 __all__ = [
+    "CLUSTER_SITES",
     "FaultInjector",
     "FaultSpec",
     "InjectedCrash",
     "InjectedIOError",
+    "format_spec",
     "parse_schedule",
     "poison_batch",
+    "split_schedule",
     "NumericDivergence",
     "RecoveryCounters",
     "RecoveryError",

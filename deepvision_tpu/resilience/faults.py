@@ -44,6 +44,20 @@ Sites and their consultation points:
 ``replica_slow``    per routed request attempt; fires by injecting
                     ``ARG`` seconds of extra attempt latency (default
                     0.5) — exercises hedged retries. Alias: ``rslow``.
+``host_preempt``    per observed cluster step in the multi-host
+                    supervisor (``resilience/cluster.py``); fires by
+                    delivering the preemption notice (SIGTERM) to one
+                    live host — the coordinated save barrier + elastic
+                    resume path runs. Alias: ``preempt``.
+``host_stall``      per observed cluster step in the supervisor; fires
+                    by SIGSTOPping one live host for ``ARG`` seconds
+                    (default 2.0) — trips the straggler detector.
+                    Alias: ``hstall``.
+``worker_kill``     per merged batch in the multi-process host loader
+                    (``data/loader.py``); fires by SIGKILLing the
+                    decode worker whose turn it is — the bounded
+                    respawn-at-shard-position path runs.
+                    Alias: ``wkill``.
 ==================  =====================================================
 
 Example: ``"nan@14,ckpt@1,io@8x2"`` — NaN-poison the 15th train batch,
@@ -66,12 +80,19 @@ __all__ = [
     "InjectedIOError",
     "InjectedCrash",
     "parse_schedule",
+    "format_spec",
+    "split_schedule",
     "poison_batch",
 ]
 
 # canonical site names + accepted aliases
 SITES = ("nan_step", "data_io", "ckpt_corrupt", "stall", "dispatch_crash",
-         "replica_kill", "replica_slow")
+         "replica_kill", "replica_slow", "host_preempt", "host_stall",
+         "worker_kill")
+# the sites the CLUSTER SUPERVISOR consults (resilience/cluster.py);
+# train_dist.py splits a mixed schedule on this set so supervisor-level
+# specs never reach the in-job injector (and vice versa)
+CLUSTER_SITES = ("host_preempt", "host_stall")
 _ALIASES = {
     "nan": "nan_step", "nan_grad": "nan_step",
     "io": "data_io",
@@ -79,6 +100,9 @@ _ALIASES = {
     "crash": "dispatch_crash",
     "rkill": "replica_kill",
     "rslow": "replica_slow",
+    "preempt": "host_preempt",
+    "hstall": "host_stall",
+    "wkill": "worker_kill",
 }
 
 
@@ -163,6 +187,32 @@ def parse_schedule(spec: str) -> list[FaultSpec]:
                 f"fault spec {raw!r}: expected kind@AT[xN][:ARG] "
                 "or kind~PROB[:ARG]")
     return out
+
+
+def format_spec(spec: FaultSpec) -> str:
+    """Inverse of :func:`parse_schedule` for one spec (canonical kind
+    names; round-trips through the grammar)."""
+    if spec.prob is not None:
+        s = f"{spec.kind}~{spec.prob:g}"
+    else:
+        s = f"{spec.kind}@{spec.at}"
+        if spec.times > 1:
+            s += f"x{spec.times}"
+    if spec.arg is not None:
+        s += f":{spec.arg:g}"
+    return s
+
+
+def split_schedule(schedule: str, kinds) -> tuple[str, str]:
+    """Partition a schedule string into (specs whose kind is in
+    ``kinds``, the rest), both re-serialized through the grammar —
+    how ``train_dist.py`` routes cluster-level sites to the supervisor
+    and everything else to the in-job injectors."""
+    kinds = set(kinds)
+    mine, rest = [], []
+    for spec in parse_schedule(schedule):
+        (mine if spec.kind in kinds else rest).append(format_spec(spec))
+    return ",".join(mine), ",".join(rest)
 
 
 def _parse_int(tok: str, raw: str, what: str) -> int:
@@ -272,6 +322,27 @@ class FaultInjector:
         if spec is None:
             return None
         return spec.arg if spec.arg is not None else 0.5
+
+    def check_host_preempt(self) -> bool:
+        """Cluster-supervisor hook, per observed cluster step: True when
+        the preemption notice (SIGTERM) should be delivered to one live
+        host — the coordinated save barrier then runs in-job."""
+        return self._consult("host_preempt") is not None
+
+    def check_host_stall(self) -> float | None:
+        """Cluster-supervisor hook, per observed cluster step: seconds
+        to SIGSTOP one live host (``:ARG``, default 2.0) when scheduled,
+        else None — straggler-detector food."""
+        spec = self._consult("host_stall")
+        if spec is None:
+            return None
+        return spec.arg if spec.arg is not None else 2.0
+
+    def check_worker_kill(self) -> bool:
+        """Loader hook, per merged batch: True when the decode worker
+        whose turn it is should be SIGKILLed before the pull (the
+        bounded respawn path then runs)."""
+        return self._consult("worker_kill") is not None
 
     def corrupt_checkpoint(self, step_dir: str | Path) -> bool:
         """Checkpoint hook, per committed save: garble the largest file

@@ -17,26 +17,31 @@ Also reproduces the reference's operational behaviors:
 
 from __future__ import annotations
 
-import json
-import os
 import shutil
 from pathlib import Path
 from typing import Any
 
 import orbax.checkpoint as ocp
 
+from deepvision_tpu.train import manifest as _manifest
 from deepvision_tpu.train.loggers import Loggers
 
-MANIFEST_VERSION = 1
+MANIFEST_VERSION = _manifest.MANIFEST_VERSION
 
 
-def _hash_file(path: Path) -> str:
-    """Streaming SHA-256 — the repo's ONE implementation (incl. the
-    ``hashlib.file_digest`` fast path on 3.11+); lazy import keeps the
-    convert package off the checkpoint module's import path."""
-    from deepvision_tpu.convert.pretrained import file_digest
+def _primary_process() -> bool:
+    """True on the process that owns shared-filesystem bookkeeping. In
+    a ``jax.distributed`` run every host calls the collective
+    save/restore, but the integrity manifest (and the chaos corrupt
+    hook) must be written by exactly ONE of them — N hosts hashing and
+    replacing the same sidecar is wasted work and the write race the
+    manifest module only mitigates."""
+    try:
+        import jax
 
-    return file_digest(path, "sha256")
+        return jax.process_index() == 0
+    except Exception:  # jax absent/uninitialized: single-writer anyway
+        return True
 
 
 class CheckpointManager:
@@ -147,7 +152,11 @@ class CheckpointManager:
         epoch, GC manifests whose step dir the retention policy already
         deleted, and consult the fault injector (which corrupts AFTER
         the manifest is written — exactly the bit-rot/truncation window
-        verification exists to catch)."""
+        verification exists to catch). Primary-process-only in a
+        multi-host run: the save itself is collective, the sidecar
+        bookkeeping is single-writer."""
+        if not _primary_process():
+            return
         if self.integrity:
             self._write_manifest(epoch)
             live = {p.name for p in self.directory.iterdir()
@@ -159,53 +168,15 @@ class CheckpointManager:
             self._injector.corrupt_checkpoint(self._step_dir(epoch))
 
     def _write_manifest(self, epoch: int) -> None:
-        step_dir = self._step_dir(epoch)
-        if not step_dir.exists():  # e.g. keep_best evicted it already
-            return
-        files = {
-            str(p.relative_to(step_dir)): {
-                "size": p.stat().st_size,
-                "sha256": _hash_file(p),
-            }
-            for p in sorted(step_dir.rglob("*")) if p.is_file()
-        }
-        manifest = {"version": MANIFEST_VERSION, "epoch": int(epoch),
-                    "files": files}
-        # atomic: a SIGKILL between write and replace leaves only the
-        # tmp file — never a truncated manifest that poisons resume
-        tmp = self._manifest_path(epoch).with_suffix(".json.tmp")
-        tmp.write_text(json.dumps(manifest))
-        os.replace(tmp, self._manifest_path(epoch))
+        # atomic + multi-writer-safe (unique tmp name + os.replace):
+        # see train/manifest.write_manifest
+        _manifest.write_manifest(self.directory, epoch)
 
     def verify_epoch(self, epoch: int) -> tuple[bool, str]:
         """-> (ok, reason). An epoch with NO manifest verifies vacuously
         (pre-integrity checkpoints stay restorable); an unreadable or
         mismatching manifest fails it."""
-        step_dir = self._step_dir(epoch)
-        if not step_dir.exists():
-            return False, "step directory missing"
-        mp = self._manifest_path(epoch)
-        if not mp.exists():
-            return True, "no manifest (pre-integrity checkpoint)"
-        try:
-            manifest = json.loads(mp.read_text())
-            files = manifest["files"]
-            for rel, want in files.items():
-                p = step_dir / rel
-                if not p.is_file():
-                    return False, f"missing file {rel}"
-                if p.stat().st_size != want["size"]:
-                    return False, (f"size mismatch {rel}: "
-                                   f"{p.stat().st_size} != {want['size']}")
-                if _hash_file(p) != want["sha256"]:
-                    return False, f"checksum mismatch {rel}"
-        except (ValueError, KeyError, TypeError, AttributeError,
-                OSError) as e:
-            # parses-but-wrong-schema manifests and files vanishing
-            # mid-scan are corruption too — verification must FAIL
-            # them, never crash on them
-            return False, f"unreadable/malformed manifest: {e}"
-        return True, "ok"
+        return _manifest.verify_manifest(self.directory, epoch)
 
     def quarantine_epoch(self, epoch: int) -> Path:
         """Move a corrupt epoch (and its manifest) into ``quarantine/``

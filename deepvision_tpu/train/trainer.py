@@ -291,8 +291,103 @@ class Trainer:
             if profile_steps else None
         )
         self._global_step = 0
+        # multi-host cluster coordination (resilience/cluster.py):
+        # attach_cluster() sets the member; None = single-host behavior
+        # exactly as before
+        self.cluster = None
+        self._cluster_stop: int | None = None
         # per-epoch KeySeq derived in train_epoch from this root key
         self._base_key = jax.random.key(seed + 1)
+
+    # -- multi-host cluster (resilience/cluster.py) ----------------------
+    def attach_cluster(self, member) -> None:
+        """Join a cluster coordination directory: per-batch heartbeats,
+        the coordinated checkpoint-on-preempt barrier, and the degraded
+        exit rules. Call before :meth:`resume`/:meth:`fit`. In cluster
+        mode the PreemptLock is bypassed — the supervisor serializes
+        generations, and a shared flock would deadlock the COLLECTIVE
+        preemption save (every host must be inside save() at once)."""
+        self.cluster = member
+
+    def _cluster_poll(self, epoch: int, dispatched: int) -> bool:
+        """Pre-dispatch poll (once per batch): heartbeat + barrier
+        marker. Returns True when the epoch must be ABANDONED now —
+        a stale marker from an earlier epoch means peers already
+        exited, and any further fetch could wedge on a collective
+        nobody will ever complete (so the caller returns WITHOUT the
+        final drain)."""
+        m = self.cluster
+        m.beat(self._global_step, epoch)
+        if self._preempt and m.read_barrier() is None:
+            # this host holds the preemption notice: publish the
+            # cluster-wide stop point far enough ahead (barrier_lead >
+            # 2x the forced fetch cadence below) that every peer sees
+            # the marker strictly before passing it
+            mk = m.write_barrier(epoch, dispatched + m.barrier_lead)
+            print(f"[cluster] host {m.host}: preemption notice — save "
+                  f"barrier requested at epoch {mk.get('epoch', epoch)} "
+                  f"step {mk.get('stop_step')}", flush=True)
+        mark = m.read_barrier()
+        if mark is None:
+            return False
+        self._preempt = True  # the notice is cluster-wide from here on
+        if mark.get("after_epoch") is not None:
+            if mark["after_epoch"] < epoch:
+                return self._cluster_degrade(
+                    f"stale after-epoch marker ({mark['after_epoch']} < "
+                    f"epoch {epoch}): peers exited at the boundary")
+            return False  # exit after this epoch's save (boundary check)
+        if mark["epoch"] < epoch:
+            return self._cluster_degrade(
+                f"stale save barrier for epoch {mark['epoch']} "
+                f"(now in epoch {epoch})")
+        if mark["epoch"] == epoch and self._cluster_stop is None:
+            if dispatched >= mark["stop_step"]:
+                # at-or-past the stop on FIRST sight (>=: this poll runs
+                # pre-dispatch, so even equality means batch `stop`
+                # would dispatch next and wedge every peer's drain at an
+                # unmatched collective) — the skew invariant was
+                # violated; degrade instead of hanging
+                return self._cluster_degrade(
+                    f"save barrier step {mark['stop_step']} already "
+                    f"reached (dispatched {dispatched})")
+            self._cluster_stop = int(mark["stop_step"])
+        return False
+
+    def _cluster_degrade(self, why: str) -> bool:
+        print(f"[cluster] host {self.cluster.host}: {why}; exiting "
+              "WITHOUT a coordinated save — resume falls back to the "
+              "newest commonly-verified epoch", flush=True)
+        self.preempted = True
+        return True
+
+    def _cluster_maybe_save(self, epoch: int, dispatched: int,
+                            drain) -> bool:
+        """Post-dispatch barrier stop: every host halts at the SAME
+        dispatched-step count, rendezvouses on arrive markers (file
+        polls only — a waiting host never fetches, so it cannot wedge
+        a peer), then commits ONE collective mid-epoch checkpoint. A
+        rendezvous timeout (peer lost after the notice) degrades to
+        no-save. True = epoch over, preempted."""
+        if self._cluster_stop is None or dispatched < self._cluster_stop:
+            return False
+        m = self.cluster
+        stop = self._cluster_stop
+        m.arrive(stop)
+        if not m.await_all_arrived(timeout_s=m.barrier_timeout_s):
+            return self._cluster_degrade(
+                f"save barrier at step {stop} timed out after "
+                f"{m.barrier_timeout_s:.0f}s (peer lost?)")
+        # all hosts dispatched exactly `stop` steps: every collective
+        # is matched, so this drain cannot wedge and the save commits
+        # one common step on every host
+        drain()
+        self._save_preempt(epoch, stop)
+        m.mark_committed(epoch, stop)
+        print(f"[cluster] host {m.host}: coordinated save committed at "
+              f"epoch {epoch} step {stop}", flush=True)
+        self.preempted = True
+        return True
 
     # -- preemption ------------------------------------------------------
     @property
@@ -335,18 +430,33 @@ class Trainer:
         # wedged lock holder beats losing the mid-epoch state — but into
         # the SEPARATE ckpt_preempt_unlocked/ directory, so the unlocked
         # path never deletes data the wedged holder may be touching.
-        got = self._plock.acquire(timeout=self.preempt_lock_timeout)
+        got = False
         target = self._preempt_dir
-        if not got:
-            target = self._preempt_unlocked_dir
-            print("[preempted] WARNING: preemption lock not acquired in "
-                  f"{self.preempt_lock_timeout:.0f}s; saving unlocked "
-                  f"to {target}", flush=True)
+        if self.cluster is not None:
+            # cluster mode: no flock — the supervisor serializes
+            # generations (no concurrent resumer exists) and the save
+            # below is COLLECTIVE, so hosts serializing on a lock would
+            # deadlock it. Host 0 clears; peers rendezvous on the
+            # marker so nobody opens a manager inside a directory
+            # mid-rmtree.
+            if not self.cluster.coordinate_clear(
+                    f"{epoch}-{step_in_epoch}",
+                    self._clear_preempt_ckpt):
+                print("[cluster] preempt-dir clear rendezvous timed "
+                      "out; saving anyway", flush=True)
+        else:
+            got = self._plock.acquire(timeout=self.preempt_lock_timeout)
+            if not got:
+                target = self._preempt_unlocked_dir
+                print("[preempted] WARNING: preemption lock not "
+                      f"acquired in {self.preempt_lock_timeout:.0f}s; "
+                      f"saving unlocked to {target}", flush=True)
         try:
             delay = float(os.environ.get("DVTPU_PREEMPT_SAVE_DELAY", "0"))
             if delay:  # test hook: widen the locked critical section
                 time.sleep(delay)
-            shutil.rmtree(target, ignore_errors=True)
+            if self.cluster is None:
+                shutil.rmtree(target, ignore_errors=True)
             # no integrity manifest here: the SIGTERM grace window is
             # budgeted in seconds, and preemption saves are restored
             # unverified (superseded at the next epoch save anyway)
@@ -400,7 +510,13 @@ class Trainer:
         checkpoint, else raises with an actionable message so a
         supervisor's relaunch loop effectively polls the lock.
         """
-        if epoch is None:
+        if epoch is None and self.cluster is not None:
+            # cluster mode: N hosts resume CONCURRENTLY (the restore is
+            # collective) — no flock, read-only scan; host 0 owns any
+            # clearing, at the next epoch save
+            if self._resume_from_preempt(allow_clear=False):
+                return
+        elif epoch is None:
             got = self._plock.acquire(timeout=self.preempt_lock_timeout)
             if got:
                 try:
@@ -617,6 +733,14 @@ class Trainer:
                                     retry_counters=self.rec_counters)
             try:
                 for i, device_batch in enumerate(feed):
+                    if self.cluster is not None and self._cluster_poll(
+                            epoch, start_step + i):
+                        # degraded abandon: NO final drain — peers are
+                        # gone and the pending collectives will never
+                        # complete; the process exits 143 and the
+                        # supervisor relaunches from the newest
+                        # commonly-verified epoch
+                        return None
                     if self._profiler:  # --profile-steps window (obs/);
                         # its own span: the start/stop XPlane dump costs
                         # seconds and must attribute as profiler time,
@@ -647,9 +771,22 @@ class Trainer:
                     # latency past the timeout. The watchdog forces its
                     # own drain cadence, bounded at 32 batches regardless
                     # of log_every (log_every=500 would otherwise starve
-                    # beats and false-trip healthy runs).
-                    if self._watchdog \
-                            and i % min(32, self.log_every or 32) == 0:
+                    # beats and false-trip healthy runs). Cluster mode
+                    # shifts every drain off i=0 and forces a fetch
+                    # cadence of barrier_lead//2 (capped at 32): a
+                    # host's own fetches block on every peer's
+                    # dispatched collectives, so the cadence bounds
+                    # cross-host dispatch skew strictly UNDER the
+                    # barrier lead — the invariant that guarantees
+                    # every host sees the stop marker before reaching
+                    # it, for ANY lead >= 2.
+                    cad = min(32, self.log_every or 32)
+                    if self.cluster is not None:
+                        ccad = max(1, min(
+                            32, self.cluster.barrier_lead // 2))
+                        if i % ccad == ccad - 1:
+                            drain()
+                    elif self._watchdog and i % cad == 0:
                         drain()
                     if (self.rss_limit_bytes
                             and i % (self.log_every or 32) == 0):
@@ -665,7 +802,14 @@ class Trainer:
                             )
                             self._rss_preempted = True
                             self.request_preempt()
-                    if self._preempt:
+                    if self.cluster is not None:
+                        # coordinated stop: all hosts halt at the SAME
+                        # dispatched count (the barrier marker), not at
+                        # whatever batch the signal happened to land on
+                        if self._cluster_maybe_save(
+                                epoch, start_step + i + 1, drain):
+                            return None
+                    elif self._preempt:
                         # batch-granular: the resume point is a
                         # transferred-batch index, so a preemption
                         # mid-echo-group replays the group
@@ -673,7 +817,10 @@ class Trainer:
                         self._save_preempt(epoch, start_step + i + 1)
                         self.preempted = True
                         return None
-                    if self.log_every and i % self.log_every == 0:
+                    if self.log_every and (
+                            i % self.log_every == 0
+                            if self.cluster is None
+                            else (i + 1) % self.log_every == 0):
                         drain()  # syncs mostly-finished work; O(n) total
                         # true running mean over EVERY batch so far,
                         # matching the reference
@@ -723,6 +870,8 @@ class Trainer:
                                       shard_batch(self.mesh, batch))
                 if self._watchdog:
                     self._watchdog.beat()
+                if self.cluster is not None:
+                    self.cluster.beat(self._global_step, status="eval")
                 yield out
 
         metrics, _ = aggregate_eval_parts(parts())
@@ -895,11 +1044,29 @@ class Trainer:
             # (resume ignores preemption saves older than an epoch save).
             if self._preempt_dir.exists():
                 self.ckpt.wait_until_finished()
-                if self._plock.acquire(timeout=60.0):
+                if self.cluster is not None:
+                    # single-writer clear, no lock: every host is past
+                    # the collective epoch save, so nobody reads the
+                    # preemption directory anymore
+                    if self.cluster.host == 0:
+                        self._clear_preempt_ckpt()
+                elif self._plock.acquire(timeout=60.0):
                     try:
                         self._clear_preempt_ckpt()
                     finally:
                         self._plock.release()
+            if self.cluster is not None:
+                self.cluster.beat(self._global_step, epoch,
+                                  status="boundary", force=True)
+                mark = self.cluster.read_barrier()
+                if self._preempt and mark is None:
+                    # the notice landed outside the step loop
+                    # (validate/save): publish an exit-after-epoch
+                    # marker so peers stop at THIS boundary too
+                    mark = self.cluster.write_after_epoch(epoch)
+                if mark is not None \
+                        and mark.get("after_epoch") == epoch:
+                    self._preempt = True
             if self._preempt:  # signal arrived during validate/save: the
                 self.preempted = True  # epoch is fully committed — stop
                 self.ckpt.wait_until_finished()

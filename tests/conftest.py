@@ -211,6 +211,11 @@ _SLOW_TESTS = {
     # parity pin; the op-by-op parity tests stay in the fast tier on
     # 16² canvases
     "test_full_pipeline_parity_host_vs_device_slow",
+    # cluster (ISSUE 9): the real 2-process jax.distributed preemption
+    # drill (supervisor + coordinated save + elastic resume) — the
+    # stub-worker supervision tests cover the logic in the fast tier,
+    # and `make chaos-dist-smoke` runs the real path in `make check`
+    "test_two_host_cluster_preempt_end_to_end",
 }
 # whole modules that spawn real subprocesses (jax.distributed workers)
 _SLOW_MODULES = {"test_distributed"}

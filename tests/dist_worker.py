@@ -26,6 +26,12 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    try:
+        # multiprocess CPU collectives need the explicit gloo backend
+        # on this jax build (same guard as train_dist.py)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=nproc,

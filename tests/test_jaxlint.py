@@ -1208,3 +1208,72 @@ def test_keyseq_skip_replays_split_chain():
     b = KeySeq(jax.random.key(7)).skip(5)
     assert jax.random.key_data(next(a)).tolist() == \
         jax.random.key_data(next(b)).tolist()
+
+
+# ----------------------------------------------------------- JX115
+
+
+def test_jx115_flags_cluster_calls_without_timeout(tmp_path):
+    r = lint(tmp_path, "lib/launch.py", """
+        import jax
+
+        def join_cluster(kwargs):
+            jax.distributed.initialize(**kwargs)   # unbounded join
+
+        def rendezvous(member, step):
+            member.arrive(step)
+            return member.await_all_arrived()      # unbounded barrier
+        """)
+    assert codes(r) == ["JX115", "JX115"]
+    assert "timeout" in r.findings[0].message
+    assert "hangs this process forever" in r.findings[0].message
+
+
+def test_jx115_passes_timeout_kwargs(tmp_path):
+    r = lint(tmp_path, "lib/launch.py", """
+        import jax
+
+        def join_cluster(kwargs, budget):
+            jax.distributed.initialize(
+                initialization_timeout=int(budget), **kwargs)
+
+        def rendezvous(member, step):
+            member.arrive(step)                    # not a barrier call
+            return member.await_all_arrived(timeout_s=30.0)
+
+        def barrier(client):
+            client.wait_at_barrier("b", timeout_in_ms=5000)
+
+        def unrelated_initialize(db):
+            db.initialize()                        # not distributed.*
+        """)
+    assert codes(r) == []
+
+
+def test_jx115_cluster_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(cluster_funcs=["*join_mesh*"])
+    r = lint(tmp_path, "lib/launch.py", """
+        import jax
+
+        def a(runtime):
+            runtime.join_mesh()                    # matched by the knob
+
+        def b(kwargs):
+            jax.distributed.initialize(**kwargs)   # NOT matched now
+        """, cfg=cfg)
+    assert codes(r) == ["JX115"]
+
+
+def test_load_config_reads_cluster_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        cluster_funcs = ["*join_mesh*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.cluster_funcs == ["*join_mesh*"]
+    # defaults cover the jax join + the repo's own barrier rendezvous
+    assert "*distributed.initialize" in LintConfig().cluster_funcs
+    assert "*await_all_arrived*" in LintConfig().cluster_funcs

@@ -130,3 +130,119 @@ def test_shm_ring_is_unlinked_on_close():
     next(iter(loader))  # rings exist now
     loader.close()
     assert set(glob.glob("/dev/shm/psm_*")) <= before
+
+
+class FlagKillFactory:
+    """Worker ``victim`` SIGKILLs itself when PRODUCING batch
+    ``die_at`` — unless the flag file exists; it creates the flag
+    first, so the RESPAWNED incarnation (which replays deterministically
+    through the same position) survives. Simulates a one-off OOM-kill
+    of a decode worker."""
+
+    def __init__(self, per_worker: int, victim: int, die_at: int,
+                 flag: str):
+        self.per_worker = per_worker
+        self.victim = victim
+        self.die_at = die_at
+        self.flag = flag
+
+    def __call__(self, worker_id: int, num_workers: int):
+        import os
+        import signal
+
+        for i in range(self.per_worker):
+            if worker_id == self.victim and i == self.die_at \
+                    and not os.path.exists(self.flag):
+                open(self.flag, "w").close()
+                os.kill(os.getpid(), signal.SIGKILL)
+            yield {"image": np.full((2, 4), worker_id * 100 + i,
+                                    np.int32)}
+
+
+class AlwaysDiesFactory:
+    """Worker 1 SIGKILLs itself after 2 batches on EVERY incarnation —
+    a deterministic fault the bounded respawn must give up on."""
+
+    def __call__(self, worker_id: int, num_workers: int):
+        import os
+        import signal
+
+        yield {"image": np.full((1,), worker_id * 10, np.int32)}
+        yield {"image": np.full((1,), worker_id * 10 + 1, np.int32)}
+        if worker_id == 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+        yield {"image": np.full((1,), worker_id * 10 + 2, np.int32)}
+
+
+class FlagRaiseFactory:
+    """Worker 1 raises a transient OSError at batch 2 once (flag-gated)
+    — the clean-exit death path (worker sends the error sentinel)."""
+
+    def __init__(self, flag: str):
+        self.flag = flag
+
+    def __call__(self, worker_id: int, num_workers: int):
+        import os
+
+        for i in range(4):
+            if worker_id == 1 and i == 2 \
+                    and not os.path.exists(self.flag):
+                open(self.flag, "w").close()
+                raise OSError("transient decode failure")
+            yield {"image": np.full((2, 4), worker_id * 100 + i,
+                                    np.int32)}
+
+
+def _restart_count():
+    from deepvision_tpu.obs.metrics import default_registry
+
+    return default_registry().counter("loader_worker_restarts").value
+
+
+def test_dead_worker_respawns_at_shard_position(tmp_path):
+    """A SIGKILLed worker respawns at its merge position; the merged
+    stream is IDENTICAL to an undisturbed run (deterministic round-
+    robin preserved) and the restart lands in the obs registry."""
+    undisturbed = _tags(MultiProcessLoader(TaggedFactory(4), 2))
+    before = _restart_count()
+    flag = tmp_path / "died-once"
+    healed = _tags(MultiProcessLoader(
+        FlagKillFactory(4, victim=1, die_at=2, flag=str(flag)), 2,
+        max_restarts=2))
+    assert healed == undisturbed
+    assert _restart_count() - before == 1
+
+
+def test_worker_error_respawns_and_resumes(tmp_path):
+    undisturbed = _tags(MultiProcessLoader(TaggedFactory(4), 2))
+    flag = tmp_path / "raised-once"
+    healed = _tags(MultiProcessLoader(
+        FlagRaiseFactory(str(flag)), 2, max_restarts=1))
+    assert healed == undisturbed
+
+
+def test_consecutive_deaths_fail_fast_after_budget():
+    before = _restart_count()
+    loader = MultiProcessLoader(AlwaysDiesFactory(), 2, max_restarts=2)
+    with pytest.raises(WorkerError) as ei:
+        list(loader)
+    assert "2 consecutive restarts" in str(ei.value)
+    assert _restart_count() - before == 2
+
+
+def test_zero_restarts_keeps_fail_fast_contract():
+    loader = MultiProcessLoader(AlwaysDiesFactory(), 2)
+    with pytest.raises(WorkerError):
+        list(loader)
+
+
+def test_worker_kill_fault_site_triggers_respawn():
+    from deepvision_tpu.resilience import FaultInjector
+
+    undisturbed = _tags(MultiProcessLoader(TaggedFactory(4), 2))
+    inj = FaultInjector("worker_kill@3")
+    healed = _tags(MultiProcessLoader(TaggedFactory(4), 2,
+                                      max_restarts=2,
+                                      fault_injector=inj))
+    assert healed == undisturbed
+    assert inj.fired == [("worker_kill", 3)]

@@ -32,6 +32,12 @@ Checker codes (tools/jaxlint/checkers.py):
     JX113  bare time.sleep inside a supervisor/dispatcher/router loop
            (ignores the stop event: shutdown hangs for the full
            backoff; use Event.wait(timeout))
+    JX114  host-side float32 cast feeding the device wire
+           (device_put/shard_batch/prefetcher): 4x H2D bytes — ship
+           uint8, normalize/augment on device
+    JX115  blocking cluster join/barrier (distributed.initialize,
+           wait_at_barrier, await_all_arrived, ...) without a timeout
+           argument — a missing/dead peer hangs the process forever
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

@@ -1150,3 +1150,48 @@ class F32WireChecker(Checker):
         except Exception:  # pragma: no cover - unparse is 3.9+
             return False
         return "float32" in text
+
+
+@register_checker
+class ClusterTimeoutChecker(Checker):
+    """Blocking cluster join / cross-host barrier called WITHOUT a
+    timeout argument: ``jax.distributed.initialize`` with no
+    ``initialization_timeout`` (the pre-ISSUE-9 ``train_dist.py``)
+    hangs the launcher forever when one peer of the slice never comes
+    up, and the coordination-service barriers
+    (``wait_at_barrier``/``sync_global_devices``) or the repo's own
+    save-barrier rendezvous (``await_all_arrived``) hang the SURVIVORS
+    when a peer dies mid-protocol — the exact failure the cluster
+    supervisor exists to bound. Any keyword argument matching
+    ``*timeout*`` satisfies the check (``initialization_timeout``,
+    ``timeout_in_ms``, ``timeout_s``, ...); which call names count is
+    the ``cluster_funcs`` knob (``jaxlint.toml``), matched against both
+    the dotted call name and its last attribute."""
+
+    code = "JX115"
+    name = "cluster-call-without-timeout"
+    description = ("blocking cluster join/barrier (distributed."
+                   "initialize, wait_at_barrier, ...) without a "
+                   "timeout argument (a missing peer hangs forever)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.cluster_funcs
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            la = last_attr(cn)
+            names = [n for n in (cn, la) if n]
+            if not any(fnmatch.fnmatch(n, p)
+                       for n in names for p in patterns):
+                continue
+            if any(k.arg and "timeout" in k.arg.lower()
+                   for k in node.keywords):
+                continue  # bounded: some *timeout* kwarg is present
+            yield mod.finding(
+                node, self.code,
+                f"'{cn or la}' blocks on the whole cluster with no "
+                "timeout argument — a missing/dead peer hangs this "
+                "process forever; pass initialization_timeout/"
+                "timeout_in_ms/timeout_s (supervisors must be able "
+                "to degrade, resilience/cluster.py)")

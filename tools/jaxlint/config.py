@@ -284,6 +284,18 @@ class LintConfig:
         "DevicePrefetcher", "device_prefetch",
         "make_array_from_process_local_data",
     ])
+    # Blocking cluster joins / cross-host barriers (JX115): calling one
+    # without a timeout argument hangs the launcher/supervisor forever
+    # on a missing peer — jax.distributed.initialize takes
+    # initialization_timeout, the coordination-service barriers take
+    # timeout_in_ms, and the repo's own save-barrier rendezvous takes
+    # timeout_s. Matched against the dotted call name AND its last
+    # attribute; any keyword matching ``*timeout*`` satisfies the check.
+    cluster_funcs: list[str] = field(default_factory=lambda: [
+        "*distributed.initialize", "*wait_at_barrier*",
+        "*sync_global_devices*", "*await_all_arrived*",
+        "*blocking_key_value_get*",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -303,7 +315,8 @@ def load_config(path: str | Path | None) -> LintConfig:
         "traced_name_patterns", "jit_wrappers", "static_return_calls",
         "key_fresheners", "key_name_patterns", "constraint_funcs",
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
-        "timed_funcs", "loop_sleep_funcs", "wire_funcs", "disable",
+        "timed_funcs", "loop_sleep_funcs", "wire_funcs",
+        "cluster_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

@@ -172,6 +172,13 @@ def parse_args():
                         "round-robin merge over disjoint file shards) — "
                         "the multi-core answer to a decode-bound host; "
                         "ImageNet record runs only")
+    p.add_argument("--max-worker-restarts", type=int, default=2,
+                   help="bounded self-healing for a dead loader decode "
+                        "worker: respawn it at its shard position "
+                        "(merge order preserved, counted as "
+                        "loader_worker_restarts) up to this many "
+                        "CONSECUTIVE deaths per worker, then fail "
+                        "fast; 0 = fail on the first death")
     p.add_argument("--prefetch-depth", type=int, default=2,
                    help="device batches the async feed keeps in flight "
                         "ahead of the step (data/prefetch.py); 1 = "
@@ -239,6 +246,9 @@ def main():
     if args.loader_workers < 1:
         raise SystemExit(
             f"--loader-workers must be >= 1, got {args.loader_workers}")
+    if args.max_worker_restarts < 0:
+        raise SystemExit(f"--max-worker-restarts must be >= 0, got "
+                         f"{args.max_worker_restarts}")
     if args.loader_workers > 1 and not (
             args.data_dir and cfg["dataset"] == "imagenet"):
         raise SystemExit(
@@ -263,6 +273,21 @@ def main():
     if args.mixup < 0:
         raise SystemExit(f"--mixup must be >= 0, got {args.mixup}")
     _maybe_enable_trace(args)
+    # recovery/injector built BEFORE the data factories: the loader's
+    # worker_kill chaos site and bounded respawn hook into the ImageNet
+    # reader construction below
+    recovery = None
+    if args.recover:
+        from deepvision_tpu.resilience import RecoveryPolicy
+
+        recovery = RecoveryPolicy(max_rollbacks=args.max_rollbacks,
+                                  lr_rewarm=args.lr_rewarm)
+    injector = None
+    if args.faults:
+        from deepvision_tpu.resilience import FaultInjector
+
+        injector = FaultInjector(args.faults, seed=args.fault_seed)
+        print(f"fault injection armed: {args.faults!r}", flush=True)
     if cfg["dataset"].startswith("gan"):
         if args.recover or args.faults:
             raise SystemExit(
@@ -376,6 +401,8 @@ def main():
             steps_per_epoch=args.steps_per_epoch,
             device_aug=args.device_aug,
             loader_workers=args.loader_workers,
+            max_worker_restarts=args.max_worker_restarts,
+            fault_injector=injector,
         )
     elif args.data_dir and cfg["dataset"] == "mnist":
         import os
@@ -498,19 +525,6 @@ def main():
             for f in (train_data, val_data)
         )
 
-    recovery = None
-    if args.recover:
-        from deepvision_tpu.resilience import RecoveryPolicy
-
-        recovery = RecoveryPolicy(max_rollbacks=args.max_rollbacks,
-                                  lr_rewarm=args.lr_rewarm)
-    injector = None
-    if args.faults:
-        from deepvision_tpu.resilience import FaultInjector
-
-        injector = FaultInjector(args.faults, seed=args.fault_seed)
-        print(f"fault injection armed: {args.faults!r}", flush=True)
-
     mesh = create_mesh()
     print(f"devices: {jax.devices()}  mesh: {mesh.shape}")
     trainer = Trainer(
@@ -529,6 +543,17 @@ def main():
         profile_steps=args.profile_steps, profile_dir=args.profile_dir,
         **step_fns,
     )
+    # multi-host cluster supervision (train_dist.py --supervise): the
+    # launcher exports the coordination dir; attach BEFORE resume() —
+    # cluster resumes are lock-free/collective and heartbeats must
+    # cover the restore
+    from deepvision_tpu.resilience.cluster import ClusterMember
+
+    member = ClusterMember.from_env()
+    if member is not None:
+        trainer.attach_cluster(member)
+        print(f"[cluster] host {member.host}/{member.nhosts} "
+              f"coordinating via {member.directory}", flush=True)
     if args.resume or args.checkpoint is not None:
         trainer.resume(args.checkpoint)
         print(f"resumed at epoch {trainer.start_epoch}"
