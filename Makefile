@@ -43,12 +43,33 @@ test:
 smoke:
 	$(PY) -m pytest tests/ -m smoke -x -q
 
-# TPU-hazard static analysis + the registry-wide abstract-eval gate
-# (tools/jaxlint/; suppressions + baseline in jaxlint.toml). Seconds-
-# cheap, runs on every PR via `make check`.
+# TPU-hazard static analysis (interprocedural; tools/jaxlint/core.py)
+# over the library AND the top-level entry points, the registry-wide
+# abstract-eval gate, and the CPU-cheap subset of the compiled-IR
+# contract gate. Suppressions + baselines/ledgers in jaxlint.toml.
+# Runs on every PR via `make check`.
+LINT_PATHS := deepvision_tpu/ tools/ train.py train_dist.py serve.py \
+              bench.py predict.py evaluate.py
 lint:
-	$(PY) -m tools.jaxlint deepvision_tpu/ train_dist.py
+	$(PY) -m tools.jaxlint $(LINT_PATHS)
 	$(PY) -m tools.jaxlint.evalcheck
+	$(PY) -m tools.jaxlint.ircheck --fast
+
+# compiled-IR contract gate, registry-wide (tools/jaxlint/ircheck.py):
+# lowers the REAL train step of every registry model and verifies
+# donation aliasing (JX104 enforcement), dtype discipline (no f64, no
+# f32 pixels on the wire), jaxpr stability across two bucket sizes,
+# collective axis names vs the mesh, and the per-model hbm_gb_per_step
+# regression ledger (±5%, jaxlint.toml [[ircheck.hbm]]). The --fast
+# subset gates every PR inside `make lint`; this full sweep compiles
+# every family (minutes on a CPU box — heavy models live here, not in
+# tier-1) and is the gate when step/model/optimizer code moves.
+lint-ir:
+	$(PY) -m tools.jaxlint.ircheck
+
+# the item-2 worklist: per-model f32 activation surface from the jaxpr
+bf16-ready:
+	$(PY) -m tools.jaxlint.ircheck --bf16-ready
 
 # serving smoke: boot the stdin-JSONL server on lenet5 (compiles its
 # bucket executables at startup), push 3 requests through the engine,
@@ -281,4 +302,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint check serve-smoke router-smoke obs-smoke feed-smoke chaos-dist-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-ir bf16-ready check serve-smoke router-smoke obs-smoke feed-smoke chaos-dist-smoke bench dryrun tensorboard find-python list-models rehearsal

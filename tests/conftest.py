@@ -216,6 +216,10 @@ _SLOW_TESTS = {
     # stub-worker supervision tests cover the logic in the fast tier,
     # and `make chaos-dist-smoke` runs the real path in `make check`
     "test_two_host_cluster_preempt_end_to_end",
+    # compiled-IR gate (ISSUE 10): real-model compiles beyond the lenet
+    # fast-tier case — the registry-wide sweep is `make lint-ir`
+    "test_ircheck_dcgan_live",
+    "test_ircheck_heavy_families_live",
 }
 # whole modules that spawn real subprocesses (jax.distributed workers)
 _SLOW_MODULES = {"test_distributed"}

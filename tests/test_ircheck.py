@@ -1,0 +1,416 @@
+"""Compiled-IR contract gate (tools/jaxlint/ircheck.py): the pure
+helpers (alias-map parse, jaxpr stability comparator, collective-axis
+collection, pixel-dtype predicate), the gate logic on cheap synthetic
+cases (donation / HBM ledger / stability failures all demonstrably
+fire), and live registry cases (lenet5 fast; heavier families in the
+slow tier — the registry-wide sweep is `make lint-ir`)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from tools.jaxlint.config import (
+    HbmBaseline,
+    IRCheckConfig,
+    load_ircheck_config,
+)
+from tools.jaxlint.ircheck import (
+    IRCase,
+    check_case,
+    collect_axis_names,
+    compare_jaxprs,
+    f32_surface,
+    make_cases,
+    parse_alias_map,
+    pixel_f32_inputs,
+)
+
+# the shipped ledger, independent of pytest's cwd (load_ircheck_config
+# silently returns defaults for a missing path by design)
+REPO_TOML = str(Path(__file__).resolve().parent.parent / "jaxlint.toml")
+
+# ---------------------------------------------------------- pure helpers
+
+_HEADER = (
+    "HloModule jit_scoped, is_scheduled=true, input_output_alias={ "
+    "{0}: (0, {}, may-alias), {1}: (2, {}, may-alias), "
+    "{2}: (5, {1}, may-alias) }, entry_computation_layout={(f32[8,8]"
+    "{1,0})->f32[8,8]{1,0}}\n\nENTRY %main {\n}\n"
+)
+
+
+def test_parse_alias_map_brace_counted():
+    # nested {} entries and a tuple param index must all survive; the
+    # regex-backtracking truncation bug returned {} here
+    assert parse_alias_map(_HEADER) == {0, 2, 5}
+    assert parse_alias_map("HloModule x\nENTRY %e {\n}\n") == set()
+
+
+def test_pixel_f32_inputs_predicate():
+    leaves = [
+        ("['image']", (8, 224, 224, 3), "float32"),   # pixels, f32: flag
+        ("['image2']", (8, 224, 224, 3), "uint8"),    # uint8 wire: ok
+        ("['boxes']", (8, 16, 4), "float32"),         # not 4-D: ok
+        ("['feat']", (8, 4, 4, 512), "float32"),      # 512 ch: not pixels
+        ("['small']", (8, 8, 8, 3), "float32"),       # <16 spatial: ok
+    ]
+    assert pixel_f32_inputs(leaves) == [
+        "['image'] float32[8, 224, 224, 3]"]
+
+
+def test_compare_jaxprs_stable_across_buckets():
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        y = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        return jnp.tanh(y) / x.shape[0]
+
+    SDS = jax.ShapeDtypeStruct
+    j1 = jax.make_jaxpr(step)(SDS((4, 8, 8, 3), np.uint8))
+    j2 = jax.make_jaxpr(step)(SDS((8, 8, 8, 3), np.uint8))
+    assert compare_jaxprs(j1.jaxpr, j2.jaxpr, 4, 8) == []
+
+
+def test_compare_jaxprs_catches_batch_dependent_structure():
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        y = jnp.sum(x)
+        if x.shape[0] == 4:  # trace burns the batch size in: unstable
+            y = y * 2.0
+        return y
+
+    SDS = jax.ShapeDtypeStruct
+    j1 = jax.make_jaxpr(step)(SDS((4, 8), np.float32))
+    j2 = jax.make_jaxpr(step)(SDS((8, 8), np.float32))
+    probs = compare_jaxprs(j1.jaxpr, j2.jaxpr, 4, 8)
+    assert probs and "equation count" in probs[0]
+
+
+def test_compare_jaxprs_recurses_into_cond_branches():
+    import jax
+    import jax.numpy as jnp
+
+    # batch-dependent structure INSIDE a lax.cond branch: the sub-jaxprs
+    # live in a tuple-valued 'branches' param and must still be compared
+    def step(x):
+        def unrolled(v):
+            y = jnp.zeros(())
+            for i in range(v.shape[0]):  # unrolls per batch size
+                y = y + jnp.sum(v[i])
+            return y
+
+        return jax.lax.cond(jnp.sum(x) > 0, unrolled,
+                            lambda v: jnp.sum(v), x)
+
+    SDS = jax.ShapeDtypeStruct
+    j1 = jax.make_jaxpr(step)(SDS((2, 8), np.float32))
+    j2 = jax.make_jaxpr(step)(SDS((4, 8), np.float32))
+    assert compare_jaxprs(j1.jaxpr, j2.jaxpr, 2, 4)
+
+
+def test_compare_jaxprs_catches_non_batch_shape_change():
+    import jax
+    import jax.numpy as jnp
+
+    # same eqn count, but a feature dim moves -> must be reported
+    def a(x):
+        return jnp.reshape(x, (x.shape[0], 64))
+
+    def b(x):
+        return jnp.reshape(x, (x.shape[0] * 2, 32))
+
+    SDS = jax.ShapeDtypeStruct
+    j1 = jax.make_jaxpr(a)(SDS((4, 64), np.float32))
+    j2 = jax.make_jaxpr(b)(SDS((8, 64), np.float32))
+    assert compare_jaxprs(j1.jaxpr, j2.jaxpr, 4, 8)
+
+
+def test_collect_axis_names_sees_collectives_and_constraints():
+    import jax
+
+    j = jax.make_jaxpr(
+        lambda x: jax.lax.psum(x, "data"), axis_env=[("data", 1)]
+    )(1.0)
+    assert "data" in collect_axis_names(j.jaxpr)
+    # a sharding constraint's PartitionSpec names count too
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepvision_tpu.core import create_mesh
+
+    mesh = create_mesh(1, 1)
+    sh = NamedSharding(mesh, P("data"))
+    j2 = jax.make_jaxpr(
+        lambda x: jax.lax.with_sharding_constraint(x, sh)
+    )(jnp.zeros((4, 4)))
+    assert "data" in collect_axis_names(j2.jaxpr)
+
+
+def test_f32_surface_reports_large_intermediates():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        big = x.astype(jnp.float32) * 2.0       # 1M f32 elements = 4MB
+        return jnp.sum(big)
+
+    j = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((1024, 1024), np.uint8))
+    surf = f32_surface(j.jaxpr, min_bytes=1 << 20)
+    assert surf["total_mb"] >= 4.0
+    assert any(k.startswith("f32[1024,1024]") for k in surf["shapes"])
+
+
+# ------------------------------------------------- gate logic (synthetic)
+
+
+def _toy_case(stable: bool = True, batch: int = 4) -> IRCase:
+    """A seconds-cheap synthetic case exercising the full check path
+    (build -> two lowerings -> compile -> every contract)."""
+
+    def build(b: int):
+        import jax
+
+        SDS = jax.ShapeDtypeStruct
+        # 4 MB of state so hbm_gb_per_step survives the 3-decimal
+        # rounding the ledger stores (the tolerance tests divide it)
+        state = {"w": SDS((1 << 20,), np.float32)}
+        batch_sds = {"image": SDS((b, 32, 32, 3), np.uint8)}
+
+        def step_fn(state, batch, key):
+            import jax.numpy as jnp
+
+            x = jnp.mean(batch["image"].astype(jnp.float32))
+            w = state["w"] + x
+            if not stable and batch["image"].shape[0] == 4:
+                w = w * 2.0  # batch size burned into the trace
+            return {"w": w}, {"loss": x}
+
+        return state, batch_sds, step_fn
+
+    return IRCase("toy", ("toy",), batch, build)
+
+
+def test_check_case_toy_passes_all_contracts():
+    rep = check_case(_toy_case(), IRCheckConfig())
+    assert rep["ok"], rep["failures"]
+    assert rep["donated_fraction"] == 1.0
+    assert rep["f64"] is False
+    assert rep["stability_diffs"] == []
+    assert rep.get("hbm_unbaselined") is True  # noted, not failed
+
+
+def test_check_case_catches_bucket_instability():
+    rep = check_case(_toy_case(stable=False), IRCheckConfig())
+    assert not rep["ok"]
+    assert any("unstable across buckets" in f for f in rep["failures"])
+
+
+def test_check_case_donation_gate_fires_and_waives(monkeypatch):
+    import tools.jaxlint.ircheck as ircheck
+
+    # simulate XLA refusing to alias anything
+    monkeypatch.setattr(ircheck, "parse_alias_map", lambda hlo: set())
+    rep = check_case(_toy_case(), IRCheckConfig())
+    assert not rep["ok"]
+    assert any("aliased input->output" in f for f in rep["failures"])
+    # ...a reasoned ledger entry waives exactly that model
+    cfg = load_ircheck_config(None)
+    from tools.jaxlint.config import DonationWaiver
+
+    cfg.donation.append(DonationWaiver(
+        model="toy", reason="test fixture", max_undonated_fraction=1.0))
+    rep = check_case(_toy_case(), cfg)
+    assert rep["ok"], rep["failures"]
+    assert cfg.donation[0].hits == 1
+    assert any("donation waived" in n for n in rep["notes"])
+    # an INSUFFICIENT waiver still fails — but counts as consulted, so
+    # the run summary won't advise deleting a waiver that just fired
+    tight = load_ircheck_config(None)
+    tight.donation.append(DonationWaiver(
+        model="toy", reason="too tight", max_undonated_fraction=0.01))
+    rep = check_case(_toy_case(), tight)
+    assert not rep["ok"]
+    assert any("waiver allows only" in f for f in rep["failures"])
+    assert tight.donation[0].hits == 1
+
+
+def test_check_case_hbm_ledger_gates_regressions():
+    base = dict(model="toy", platform=None, batch=4, mesh="1x1")
+
+    def cfg_with(gb):
+        import jax
+
+        cfg = IRCheckConfig()
+        cfg.hbm.append(HbmBaseline(**{
+            **base, "platform": jax.default_backend(),
+            "hbm_gb_per_step": gb}))
+        return cfg
+
+    measured = check_case(_toy_case(), IRCheckConfig())["hbm_gb_per_step"]
+    # at baseline: clean
+    rep = check_case(_toy_case(), cfg_with(measured))
+    assert rep["ok"] and "hbm_unbaselined" not in rep
+    # regression beyond +5%: fail (the number only ratchets down)
+    rep = check_case(_toy_case(), cfg_with(measured / 2))
+    assert not rep["ok"]
+    assert any("exceeds baseline" in f for f in rep["failures"])
+    # improvement beyond -5%: nudge to re-record, still ok
+    rep = check_case(_toy_case(), cfg_with(measured * 3))
+    assert rep["ok"]
+    assert any("re-record" in n for n in rep["notes"])
+
+
+def test_check_case_hbm_gate_disarms_safely_without_cost_analysis(
+        monkeypatch):
+    """A build whose cost_analysis() is unavailable yields 0.0 — that
+    must read as 'ledger not evaluated', never as a miraculous
+    improvement, and must not be offered for recording."""
+    import tools.hbm_budget as hbm_budget
+
+    monkeypatch.setattr(hbm_budget, "hbm_gb_per_step", lambda c: 0.0)
+    cfg = IRCheckConfig()
+    import jax
+
+    cfg.hbm.append(HbmBaseline(
+        model="toy", platform=jax.default_backend(), batch=4,
+        mesh="1x1", hbm_gb_per_step=0.012))
+    rep = check_case(_toy_case(), cfg)
+    assert rep["ok"], rep["failures"]
+    assert "hbm_gb_per_step" not in rep
+    assert "hbm_unbaselined" not in rep
+    assert any("cost analysis unavailable" in n for n in rep["notes"])
+
+
+def test_run_fast_with_empty_subset_fails(tmp_path, capsys):
+    """An empty/mistyped fast_models list must not let the per-PR gate
+    pass green having verified nothing."""
+    from tools.jaxlint.ircheck import run
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text("[ircheck]\nfast_models = []\n")
+    assert run(None, config=str(p), fast=True) == 2
+    p.write_text('[ircheck]\nfast_models = ["lennet5"]\n')  # typo'd
+    assert run(None, config=str(p), fast=True) == 2
+
+
+def test_check_case_pixel_dtype_gate_fires_and_waives():
+    def build(b):
+        import jax
+
+        SDS = jax.ShapeDtypeStruct
+        state = {"w": SDS((4,), np.float32)}
+        batch_sds = {"image": SDS((b, 32, 32, 3), np.float32)}  # f32 wire
+
+        def step_fn(state, batch, key):
+            import jax.numpy as jnp
+
+            return state, {"loss": jnp.mean(batch["image"])}
+
+        return state, batch_sds, step_fn
+
+    case = IRCase("toyf32", ("toyf32",), 4, build)
+    rep = check_case(case, IRCheckConfig())
+    assert not rep["ok"]
+    assert any("H2D boundary" in f for f in rep["failures"])
+    cfg = IRCheckConfig()
+    from tools.jaxlint.config import DtypeWaiver
+
+    cfg.dtype.append(DtypeWaiver(model="toyf32", reason="test fixture"))
+    rep = check_case(case, cfg)
+    assert rep["ok"], rep["failures"]
+    assert cfg.dtype[0].hits == 1
+
+
+def test_check_case_guards_state_parameter_alignment():
+    """An UNUSED state leaf gets pruned by jit (keep_unused=False) and
+    renumbers the entry parameters — attribution by position would lie,
+    so the gate must refuse instead. An unused KEY (last flat input,
+    e.g. lenet/hourglass take no rng) must stay harmless."""
+
+    def build(b):
+        import jax
+
+        SDS = jax.ShapeDtypeStruct
+        # 'a_dead' sorts FIRST in the dict flatten order and is never
+        # read by the step -> pruned -> every later state param shifts
+        state = {"a_dead": SDS((128,), np.float32),
+                 "w": SDS((64,), np.float32)}
+        batch_sds = {"image": SDS((b, 32, 32, 3), np.uint8)}
+
+        def step_fn(state, batch, key):
+            import jax.numpy as jnp
+
+            x = jnp.mean(batch["image"].astype(jnp.float32))
+            return {"a_dead": jnp.zeros((128,)),
+                    "w": state["w"] + x}, {"loss": x}
+
+        return state, batch_sds, step_fn
+
+    rep = check_case(IRCase("toyprune", ("toyprune",), 4, build),
+                     IRCheckConfig())
+    assert not rep["ok"]
+    assert any("do not align with entry parameters" in f
+               for f in rep["failures"])
+    # the plain toy (which never reads its key either) stays clean:
+    # a pruned LAST input does not shift the state prefix
+    assert check_case(_toy_case(), IRCheckConfig())["ok"]
+
+
+def test_check_case_reports_build_crash_as_failure():
+    def build(b):
+        raise RuntimeError("boom")
+
+    rep = check_case(IRCase("broken", ("broken",), 4, build),
+                     IRCheckConfig())
+    assert not rep["ok"]
+    assert any("boom" in f for f in rep["failures"])
+    assert "trace" in rep
+
+
+# --------------------------------------------------- registry coverage
+
+
+def test_every_registry_model_has_an_ircheck_case():
+    import deepvision_tpu.models as models
+
+    covered = {m for case in make_cases().values() for m in case.models}
+    missing = sorted(set(models.list_models()) - covered)
+    assert not missing, (
+        f"registry entries without an ircheck case: {missing} — add a "
+        "case to tools/jaxlint/ircheck.make_cases so the IR gate covers "
+        "them")
+
+
+def test_ircheck_lenet5_live():
+    """The fast-tier live case: the real lenet5 train step passes every
+    contract on this box (dtype waived by the shipped ledger)."""
+    cfg = load_ircheck_config(REPO_TOML)
+    rep = check_case(make_cases()["lenet5"], cfg)
+    assert rep["ok"], rep["failures"]
+    assert rep["donated_fraction"] >= cfg.donation_min_fraction
+    assert rep["f64"] is False
+
+
+def test_ircheck_dcgan_live():
+    """GAN composite case (covers both dcgan registry entries): the
+    simultaneous G+D update donates its full GANState."""
+    cfg = load_ircheck_config(REPO_TOML)
+    rep = check_case(make_cases()["dcgan"], cfg)
+    assert rep["ok"], rep["failures"]
+    assert rep["donated_fraction"] >= cfg.donation_min_fraction
+
+
+def test_ircheck_heavy_families_live():
+    """Slow tier: one deep classifier + one detector through the full
+    gate (the registry-wide sweep is `make lint-ir`)."""
+    cfg = load_ircheck_config(REPO_TOML)
+    cases = make_cases()
+    for name in ("resnet50", "yolov3"):
+        rep = check_case(cases[name], cfg)
+        assert rep["ok"], (name, rep["failures"])
+        assert rep["stability_diffs"] == []

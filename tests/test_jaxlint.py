@@ -1250,6 +1250,420 @@ def test_jx115_passes_timeout_kwargs(tmp_path):
     assert codes(r) == []
 
 
+def lint_files(tmp_path, files: dict[str, str],
+               cfg: LintConfig | None = None, **kw):
+    """Write several modules and lint them in ONE run_paths call — the
+    interprocedural ProjectContext spans exactly one invocation."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    cfg = cfg or LintConfig(
+        traced_dirs=["traced"], data_dirs=["data"],
+        parallel_dirs=["parallel"],
+    )
+    return run_paths([tmp_path], cfg, root=tmp_path, **kw)
+
+
+# ----------------------------------- interprocedural layer (ISSUE 10)
+
+
+_HELPERS_SRC = """
+    import numpy as np
+
+    def fetch_loss(m):
+        # the hazard hides here: a host materialization
+        return float(np.asarray(m["loss"]))
+
+    def relabel(m):
+        return {k: v for k, v in m.items()}
+"""
+
+_LOOP_SRC = """
+    from deepvision_tpu.data.prefetch import device_prefetch
+    from lib.helpers import fetch_loss, relabel
+
+    def epoch(batches, mesh, step, state):
+        losses = []
+        for db in device_prefetch(batches, mesh):
+            state, m = step(state, db)
+            losses.append(fetch_loss(m))   # blocks via the helper
+        return state, losses
+"""
+
+
+def test_jx109_catches_sync_routed_through_imported_helper(tmp_path):
+    """THE acceptance fixture: fetch_loss is in no knob list and lives
+    in another module — only the project call graph can see the
+    np.asarray inside it."""
+    r = lint_files(tmp_path, {"lib/helpers.py": _HELPERS_SRC,
+                              "lib/loop.py": _LOOP_SRC})
+    assert [(f.path, f.code) for f in r.findings] == [
+        ("lib/loop.py", "JX109")]
+    assert "fetch_loss" in r.findings[0].message
+    assert "transitively" in r.findings[0].message
+
+
+def test_jx109_knob_based_single_file_pass_misses_it(tmp_path):
+    """The same loop linted WITHOUT the helper module in view (the old
+    per-file knob-based behavior) reports nothing — the pair documents
+    exactly what the interprocedural layer adds."""
+    r = lint_files(tmp_path, {"lib/loop.py": _LOOP_SRC})
+    assert codes(r) == []
+
+
+def test_jx109_non_blocking_helper_stays_clean(tmp_path):
+    good = _LOOP_SRC.replace("fetch_loss(m)", "relabel(m)")
+    r = lint_files(tmp_path, {"lib/helpers.py": _HELPERS_SRC,
+                              "lib/loop.py": good})
+    assert codes(r) == []
+
+
+def test_jx109_wrapper_returning_prefetcher_is_a_factory(tmp_path):
+    # make_feed is in no knob list; it RETURNS a device_prefetch result,
+    # so its consuming loop is a hot loop (discovered, id-resolved)
+    r = lint_files(tmp_path, {
+        "lib/feedlib.py": """
+            from deepvision_tpu.data.prefetch import device_prefetch
+
+            def make_feed(batches, mesh):
+                feed = device_prefetch(batches, mesh)
+                return feed
+            """,
+        "lib/loop.py": """
+            import numpy as np
+            from lib.feedlib import make_feed
+
+            def epoch(batches, mesh, step, state):
+                for db in make_feed(batches, mesh):
+                    state, m = step(state, db)
+                    np.asarray(m["loss"])     # direct sync
+                return state
+            """,
+    })
+    assert [(f.path, f.code) for f in r.findings] == [
+        ("lib/loop.py", "JX109")]
+
+
+def test_jx101_reaches_helpers_across_module_boundary(tmp_path):
+    """A helper imported from another module and called by a jitted
+    function is linted as traced — np.asarray inside it flags, and the
+    single-module lint (old behavior) demonstrably misses it."""
+    files = {
+        "lib/util.py": """
+            import numpy as np
+
+            def materialize(x):
+                return np.asarray(x)
+            """,
+        "lib/steps.py": """
+            import jax
+            from lib.util import materialize
+
+            def forward(x):
+                return materialize(x)
+
+            f = jax.jit(forward)
+            """,
+    }
+    r = lint_files(tmp_path, files)
+    assert [(f.path, f.code) for f in r.findings] == [
+        ("lib/util.py", "JX101")]
+    # the helper's module alone: clean (nothing marks it traced)
+    r = lint_files(tmp_path / "solo", {"lib/util.py": files["lib/util.py"]})
+    assert codes(r) == []
+
+
+def test_traced_closure_sees_through_partial_into_wrappers(tmp_path):
+    # compile_train_step(partial(step_fn, ...)) in another module marks
+    # step_fn (and its callees) traced — the repo's train.py idiom
+    r = lint_files(tmp_path, {
+        "lib/steps.py": """
+            def run_update(state, batch, key):
+                return prep(batch)
+
+            def prep(b):
+                return b.tolist()     # host sync inside traced code
+            """,
+        "lib/main.py": """
+            from functools import partial
+
+            from lib.steps import run_update
+            from deepvision_tpu.core.step import compile_train_step
+
+            def build(mesh):
+                return compile_train_step(
+                    partial(run_update, key=None), mesh)
+            """,
+    })
+    assert [(f.path, f.code) for f in r.findings] == [
+        ("lib/steps.py", "JX101")]
+
+
+def test_jx114_f32_cast_returned_by_helper(tmp_path):
+    files = {
+        "lib/casts.py": """
+            import numpy as np
+
+            def to_f32(x):
+                return x.astype(np.float32) / 255.0
+
+            def passthrough(x):
+                return x
+            """,
+        "lib/feed.py": """
+            import jax
+            from lib.casts import to_f32, passthrough
+
+            def feed(mesh, b):
+                return jax.device_put(to_f32(b["image"]))   # f32 wire
+
+            def feed_ok(mesh, b):
+                return jax.device_put(passthrough(b["image"]))
+            """,
+    }
+    r = lint_files(tmp_path, files)
+    assert [(f.path, f.code, f.line) for f in r.findings] == [
+        ("lib/feed.py", "JX114", 6)]
+
+
+def test_jx114_wrapper_feeding_wire_is_a_sink(tmp_path):
+    r = lint_files(tmp_path, {
+        "lib/wire.py": """
+            import jax
+
+            def send_to_device(batch, sharding=None):
+                return jax.device_put(batch, sharding)
+            """,
+        "lib/feed.py": """
+            import numpy as np
+            from lib.wire import send_to_device
+
+            def feed(mesh, b):
+                img = b["image"].astype(np.float32)
+                return send_to_device(img)          # sink via wrapper
+
+            def feed_ok(mesh, b):
+                return send_to_device(b["image"])   # uint8 stays
+            """,
+    })
+    assert [(f.path, f.code) for f in r.findings] == [
+        ("lib/feed.py", "JX114")]
+
+
+def test_self_calls_resolve_within_the_enclosing_class_only(tmp_path):
+    """A blocking Reader.fetch must not taint Trainer's self.fetch():
+    self-resolution is scoped to the enclosing class (cross-class
+    same-name methods are not guilt by association)."""
+    r = lint_files(tmp_path, {
+        "lib/both.py": """
+            import numpy as np
+            from deepvision_tpu.data.prefetch import device_prefetch
+
+            class Reader:
+                def fetch(self, m):
+                    return np.asarray(m)        # blocking
+
+            class Trainer:
+                def fetch(self, m):
+                    return m                    # harmless
+
+                def epoch(self, batches, mesh, step, state):
+                    for db in device_prefetch(batches, mesh):
+                        state, m = step(state, db)
+                        self.fetch(m)           # Trainer's: clean
+                    return state
+            """,
+    })
+    assert codes(r) == []
+    # ...and the SAME shape flags when the enclosing class's method
+    # really blocks
+    r = lint_files(tmp_path / "bad", {
+        "lib/both.py": """
+            import numpy as np
+            from deepvision_tpu.data.prefetch import device_prefetch
+
+            class Trainer:
+                def fetch(self, m):
+                    return np.asarray(m)        # blocking, same class
+
+                def epoch(self, batches, mesh, step, state):
+                    for db in device_prefetch(batches, mesh):
+                        state, m = step(state, db)
+                        self.fetch(m)
+                    return state
+            """,
+    })
+    assert codes(r) == ["JX109"]
+
+
+def test_parameter_shadowing_blocks_bare_name_resolution(tmp_path):
+    """A call through a PARAMETER that happens to share a module-level
+    def's name is dynamic — resolving it to the def would flag clean
+    code (the repo passes step callables as parameters everywhere)."""
+    r = lint_files(tmp_path, {
+        "lib/loop.py": """
+            import numpy as np
+            from deepvision_tpu.data.prefetch import device_prefetch
+
+            def materialize(x):
+                return np.asarray(x)     # blocking, but NOT the callee
+
+            def epoch(batches, mesh, materialize, state):
+                for db in device_prefetch(batches, mesh):
+                    state = materialize(db)   # the parameter: clean
+                return state
+
+            def epoch_local(batches, mesh, step, state):
+                step = make_compiled(step)    # local binding shadows too
+                for db in device_prefetch(batches, mesh):
+                    state, m = step(state, db)
+                return state
+            """,
+    })
+    assert codes(r) == []
+
+
+def test_bare_name_never_resolves_to_a_method(tmp_path):
+    """A bare call `fetch(m)` can only be a module-level/nested def or
+    an import — an unrelated `Reader.fetch` method in the same module
+    must not shadow the harmless imported `fetch`."""
+    r = lint_files(tmp_path, {
+        "lib/ext.py": """
+            def fetch(m):
+                return m          # harmless
+            """,
+        "lib/loop.py": """
+            import numpy as np
+            from deepvision_tpu.data.prefetch import device_prefetch
+            from lib.ext import fetch
+
+            class Reader:
+                def fetch(self, m):
+                    return np.asarray(m)   # blocking, but a METHOD
+
+            def epoch(batches, mesh, step, state):
+                for db in device_prefetch(batches, mesh):
+                    state, m = step(state, db)
+                    fetch(m)               # the import: clean
+                return state
+            """,
+    })
+    assert codes(r) == []
+
+
+def test_discovered_sets_resolve_instead_of_name_matching(tmp_path):
+    """A method merely NAMED like a discovered sink must not flag: the
+    discovered sets match by resolved def, not by bare name (the
+    predict.py `served.run` false-positive class)."""
+    r = lint_files(tmp_path, {
+        "lib/wire.py": """
+            import jax
+
+            def run(batch):
+                return jax.device_put(batch)    # a discovered sink
+            """,
+        "lib/other.py": """
+            import numpy as np
+
+            def evaluate(served, b):
+                img = b["image"].astype(np.float32)
+                return served.run(img)   # unresolvable attr: no finding
+            """,
+    })
+    assert codes(r) == []
+
+
+# ------------------------------------------- ircheck config (ISSUE 10)
+
+
+def test_baseline_entry_without_reason_is_rejected(tmp_path):
+    from tools.jaxlint.config import TomlError
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(textwrap.dedent("""
+        [[baseline]]
+        path = "a.py"
+        code = "JX101"
+        """))
+    with pytest.raises(TomlError, match="no 'reason'"):
+        load_config(p)
+
+
+def test_ircheck_config_roundtrip(tmp_path):
+    from tools.jaxlint.config import load_ircheck_config
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(textwrap.dedent("""
+        [ircheck]
+        donation_min_fraction = 0.95
+        hbm_tolerance = 0.1
+        fast_models = ["lenet5"]
+
+        [[ircheck.donation]]
+        model = "hourglass104"
+        reason = "checked path keeps inputs alive"
+        max_undonated_fraction = 0.5
+
+        [[ircheck.hbm]]
+        model = "resnet50"
+        platform = "cpu"
+        mesh = "1x1"
+        batch = 8
+        hbm_gb_per_step = 13.63
+
+        [[ircheck.dtype]]
+        model = "dcgan"
+        reason = "f32 [-1,1] reals; no record pipeline"
+        """))
+    cfg = load_ircheck_config(p)
+    assert cfg.donation_min_fraction == 0.95
+    assert cfg.hbm_tolerance == 0.1
+    assert cfg.fast_models == ["lenet5"]
+    w = cfg.donation_waiver("hourglass104")
+    assert w is not None and w.max_undonated_fraction == 0.5
+    assert cfg.hbm_baseline("resnet50", "cpu", "1x1", 8).hbm_gb_per_step \
+        == 13.63
+    assert cfg.hbm_baseline("resnet50", "tpu", "1x1", 8) is None
+    assert cfg.hbm_baseline("resnet50", "cpu", "1x1", 16) is None
+    assert cfg.dtype_waiver("dcgan") is not None
+    # defaults when the file is absent
+    dflt = load_ircheck_config(tmp_path / "nope.toml")
+    assert dflt.donation_min_fraction == 0.99
+    assert dflt.hbm_tolerance == 0.05
+
+
+def test_ircheck_waivers_without_reason_are_rejected(tmp_path):
+    from tools.jaxlint.config import TomlError, load_ircheck_config
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(textwrap.dedent("""
+        [[ircheck.donation]]
+        model = "resnet50"
+        """))
+    with pytest.raises(TomlError, match="no\\s+'reason'"):
+        load_ircheck_config(p)
+    p.write_text(textwrap.dedent("""
+        [[ircheck.dtype]]
+        model = "resnet50"
+        """))
+    with pytest.raises(TomlError, match="no\\s+'reason'"):
+        load_ircheck_config(p)
+
+
+def test_repo_ircheck_ledgers_parse_with_cpu_baselines():
+    """The shipped jaxlint.toml carries the recorded per-model HBM
+    ledger for this box's platform and the reasoned dtype waivers —
+    the regression gate is live, not latent."""
+    from tools.jaxlint.config import load_ircheck_config
+
+    cfg = load_ircheck_config(REPO / "jaxlint.toml")
+    assert len(cfg.hbm) >= 20
+    assert all(b.platform for b in cfg.hbm)
+    assert all(w.reason for w in cfg.dtype)
+    assert all(w.reason for w in cfg.donation)
+
+
 def test_jx115_cluster_funcs_knob_overrides(tmp_path):
     cfg = LintConfig(cluster_funcs=["*join_mesh*"])
     r = lint(tmp_path, "lib/launch.py", """

@@ -32,6 +32,13 @@ copy/transpose, and everything else. The report prints:
     outputs are split into their elements, so a conv epilogue writing
     `(f32[256], ..., bf16[256,56,56,256])` counts against the big
     activation shape, not the first scalar element).
+
+Since ISSUE 10 the accounting half of this file is a LIBRARY consumed
+by the compiled-IR contract gate (``tools/jaxlint/ircheck.py``): the
+HBM-budget regression ledger compares :func:`hbm_gb_per_step` against
+the per-model baselines in ``jaxlint.toml`` so the 76 GB number can
+only go down. Import :func:`cost_analysis_dict`, :func:`strip_layouts`
+and :func:`budget_report`; the CLI below stays the human entry point.
 """
 
 from __future__ import annotations
@@ -40,6 +47,7 @@ import json
 import re
 import sys
 from collections import defaultdict
+from dataclasses import dataclass, field
 from pathlib import Path
 
 _DTYPE_BYTES = {
@@ -72,6 +80,34 @@ def shape_elements(shape_str: str) -> list[tuple[str, int]]:
     string — one entry per tuple element, one total for plain shapes."""
     return [(f"{dt}[{dims}]", _dims_bytes(dt, dims))
             for dt, dims in _SHAPE_RE.findall(shape_str)]
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """Compiled-executable ``cost_analysis()`` as one flat dict across
+    jax versions — newer jax returns a dict, older (0.4.x) a list with
+    one per-device dict; ``{}`` when unavailable. The single seam every
+    consumer (bench.py, tools/profile_step.py, ircheck) goes through,
+    so version skew is handled once."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
+def hbm_gb_per_step(compiled) -> float:
+    """XLA's aggregate "bytes accessed" for one compiled step, in GB —
+    the number the jaxlint.toml HBM-budget regression ledger pins."""
+    return float(cost_analysis_dict(compiled).get("bytes accessed", 0.0)) / 1e9
+
+
+def strip_layouts(hlo_text: str) -> str:
+    """Drop TPU layout/tiling annotations printed after every shape
+    (``f32[8,8]{1,0:T(8,128)}``) so shape parsing is uniform with the
+    CPU format."""
+    return re.sub(r"(?<=\])\{[^{}]*\}", "", hlo_text)
 
 
 # one instruction definition: "  %name = <shape> opcode(...)..."
@@ -126,40 +162,36 @@ def categorize(opcode: str, line: str) -> str:
     return opcode
 
 
-def main():
-    model_name = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
-    top_n = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+@dataclass
+class BudgetReport:
+    """Itemized HBM-traffic accounting of one optimized-HLO entry."""
 
-    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
-    from tools.profile_step import build
+    total_bytes: int = 0
+    cat_bytes: dict = field(default_factory=lambda: defaultdict(int))
+    # (bytes, instr name, shape string, category), unsorted
+    items: list = field(default_factory=list)
+    # canonical >=1MB element shape -> HBM crossings / bytes each
+    shape_passes: dict = field(default_factory=lambda: defaultdict(int))
+    shape_bytes: dict = field(default_factory=dict)
 
-    state, db, compiled = build(model_name, batch)
-    ca = compiled.cost_analysis()
-    hlo = compiled.as_text()
-    # TPU HLO prints layout/tiling annotations after every shape
-    # (`f32[8,8]{1,0:T(8,128)}`); strip them so shape parsing is uniform
-    # with the CPU format.
-    hlo = re.sub(r"(?<=\])\{[^{}]*\}", "", hlo)
 
+def budget_report(hlo_text: str) -> BudgetReport:
+    """Walk the entry computation of (layout-stripped) optimized HLO and
+    charge each top-level instruction its operand + output bytes."""
     defs: dict[str, str] = {}  # name -> shape string
     rows = []
-    for name, shape, opcode, ops, line in parse_entry(hlo):
+    for name, shape, opcode, ops, line in parse_entry(hlo_text):
         defs[name] = shape
         rows.append((name, shape, opcode, ops, line))
     def_bytes = {n: shape_bytes(s) for n, s in defs.items()}
 
-    cat_bytes: dict[str, int] = defaultdict(int)
-    shape_passes: dict[str, int] = defaultdict(int)
-    shape_sz: dict[str, int] = {}
-    items = []
-    total = 0
+    rep = BudgetReport()
 
     def count_passes(shape_str: str):
         for canon, b in shape_elements(shape_str):
             if b >= 1 << 20:
-                shape_passes[canon] += 1
-                shape_sz[canon] = b
+                rep.shape_passes[canon] += 1
+                rep.shape_bytes[canon] = b
 
     for name, shape, opcode, ops, line in rows:
         if opcode in _SKIP_OPCODES:
@@ -171,8 +203,8 @@ def main():
             copied = shape_elements(shape)[0] if shape_elements(shape) else None
             b = 2 * (copied[1] if copied else 0)
             if copied and copied[1] >= 1 << 20:
-                shape_passes[copied[0]] += 2
-                shape_sz[copied[0]] = copied[1]
+                rep.shape_passes[copied[0]] += 2
+                rep.shape_bytes[copied[0]] = copied[1]
         else:
             in_b = sum(def_bytes.get(o, 0) for o in dict.fromkeys(ops))
             b = out_b + in_b
@@ -180,28 +212,52 @@ def main():
             for o in dict.fromkeys(ops):
                 if def_bytes.get(o, 0) >= 1 << 20:
                     count_passes(defs[o])
-        total += b
+        rep.total_bytes += b
         cat = categorize(opcode, line)
-        cat_bytes[cat] += b
-        items.append((b, name, shape, cat))
+        rep.cat_bytes[cat] += b
+        rep.items.append((b, name, shape, cat))
+    return rep
+
+
+def render_report(rep: BudgetReport, *, top_n: int = 25,
+                  out=sys.stdout) -> None:
+    total = max(rep.total_bytes, 1)
+    print("\n== bytes by category ==", file=out)
+    for cat, b in sorted(rep.cat_bytes.items(), key=lambda kv: -kv[1]):
+        print(f"  {b/1e9:7.2f} GB  {b/total*100:5.1f}%  {cat}", file=out)
+    print(f"\n== top {top_n} instructions by operand+output bytes ==",
+          file=out)
+    for b, name, shape, cat in sorted(rep.items, key=lambda t: -t[0])[:top_n]:
+        print(f"  {b/1e6:9.1f} MB  {cat:<34s} {name:<28s} {shape[:60]}",
+              file=out)
+    print("\n== HBM crossings per >=1MB tensor shape (passes over HBM) ==",
+          file=out)
+    for s, n in sorted(rep.shape_passes.items(),
+                       key=lambda kv: -kv[1] * rep.shape_bytes[kv[0]])[:20]:
+        print(f"  x{n:<4d} {rep.shape_bytes[s]/1e6:9.1f} MB each  {s}",
+              file=out)
+
+
+def main():
+    model_name = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    top_n = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from tools.profile_step import build
+
+    state, db, compiled = build(model_name, batch)
+    hlo = strip_layouts(compiled.as_text())
+    rep = budget_report(hlo)
 
     print(json.dumps({
         "model": model_name, "batch_per_chip": batch,
-        "sum_operand_output_gb": round(total / 1e9, 1),
-        "xla_cost_analysis_gb": round(ca.get("bytes accessed", 0.0) / 1e9, 1),
+        "sum_operand_output_gb": round(rep.total_bytes / 1e9, 1),
+        "xla_cost_analysis_gb": round(hbm_gb_per_step(compiled), 1),
         "note": "sum counts VMEM-resident re-reads too; XLA's number is "
                 "the authoritative roofline input",
     }))
-    print("\n== bytes by category ==")
-    for cat, b in sorted(cat_bytes.items(), key=lambda kv: -kv[1]):
-        print(f"  {b/1e9:7.2f} GB  {b/total*100:5.1f}%  {cat}")
-    print(f"\n== top {top_n} instructions by operand+output bytes ==")
-    for b, name, shape, cat in sorted(items, key=lambda t: -t[0])[:top_n]:
-        print(f"  {b/1e6:9.1f} MB  {cat:<34s} {name:<28s} {shape[:60]}")
-    print("\n== HBM crossings per >=1MB tensor shape (passes over HBM) ==")
-    for s, n in sorted(shape_passes.items(),
-                       key=lambda kv: -kv[1] * shape_sz[kv[0]])[:20]:
-        print(f"  x{n:<4d} {shape_sz[s]/1e6:9.1f} MB each  {s}")
+    render_report(rep, top_n=top_n)
 
 
 if __name__ == "__main__":

@@ -4,11 +4,23 @@ The classes of bugs that silently destroy TPU step time — host↔device
 syncs inside jitted code, recompilation hazards, PRNG key reuse, missing
 buffer donation, dropped sharding constraints — are exactly the ones
 pytest does not catch (the program is *correct*, just slow or subtly
-non-reproducible). This package encodes those invariants once, as an
-AST pass every PR runs:
+non-reproducible). This package encodes those invariants as a TWO-LAYER
+analyzer every PR runs:
 
-    python -m tools.jaxlint deepvision_tpu/          # static pass
+    python -m tools.jaxlint deepvision_tpu/          # interprocedural AST pass
     python -m tools.jaxlint.evalcheck                # whole-zoo abstract-eval gate
+    python -m tools.jaxlint.ircheck [--fast]         # compiled-IR contract gate
+
+Layer 1 (core.py + checkers.py) is the AST pass, interprocedural since
+ISSUE 10: a per-run ProjectContext resolves calls across function and
+module boundaries, so hazards routed through imported helpers are
+caught without ``*_funcs`` name-pattern knobs (the knobs remain as
+seeds). Layer 2 (ircheck.py) lowers + compiles the REAL train step of
+every registry model and verifies contracts on the jaxpr/optimized HLO:
+donation actually aliased (JX104 enforcement + ledger), no f64 / no f32
+pixels on the H2D boundary, jaxpr stability across bucket sizes,
+collective axes vs the mesh, and the per-model ``hbm_gb_per_step``
+regression ledger (±5%, jaxlint.toml).
 
 Checker codes (tools/jaxlint/checkers.py):
 

@@ -1,10 +1,15 @@
-"""The initial jaxlint checker set (JX101–JX108).
+"""The jaxlint checker set (JX101–JX115).
 
 Each checker targets one class of TPU step-time/correctness hazard that
 pytest cannot see (the program stays *correct* — it just recompiles,
 syncs, or silently correlates PRNG streams). See the package docstring
 for the one-line inventory and README "Static analysis" for how to add
-a checker.
+a checker. Since ISSUE 10 the loop/wire checkers (JX109/JX114) and the
+traced-reachability checkers (JX101/JX102/JX106) consume the
+interprocedural ProjectContext (tools/jaxlint/core.py): hazards routed
+through helper functions and module boundaries are resolved through the
+project call graph — the ``*_funcs`` knobs seed the callable sets, the
+dataflow closes them.
 """
 
 from __future__ import annotations
@@ -15,6 +20,7 @@ import re
 from typing import Iterator
 
 from tools.jaxlint.core import (
+    NP_MATERIALIZERS,
     Checker,
     Finding,
     FunctionNode,
@@ -23,15 +29,13 @@ from tools.jaxlint.core import (
     assign_target_names,
     call_name,
     dotted_name,
+    is_host_blocking_call,
     last_attr,
     path_matches_dir,
     register_checker,
 )
 
-_NP_MATERIALIZERS = {
-    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
-    "onp.asarray", "onp.array",
-}
+_NP_MATERIALIZERS = NP_MATERIALIZERS
 _HOST_SYNC_METHODS = {"item", "tolist"}
 _LAYOUT_ATTRS = {"reshape", "transpose", "swapaxes", "moveaxis"}
 
@@ -705,22 +709,30 @@ class PrefetchLoopSyncChecker(Checker):
     queued H2D transfers stop overlapping anything and the async feed
     degrades back to the synchronous pipeline it replaced. Fetch metrics
     after the loop, or batch them through the pending/drain pattern
-    (train/trainer.py)."""
+    (train/trainer.py).
+
+    Interprocedural (ISSUE 10): a call to a HELPER whose body
+    transitively blocks the host (the ProjectContext blocking-callable
+    summary) is the same hazard routed through a function boundary and
+    is flagged too, and a wrapper that *returns* a prefetcher counts as
+    a prefetch factory — the ``prefetch_funcs`` knob seeds the set, the
+    dataflow is the mechanism."""
 
     code = "JX109"
     name = "sync-in-prefetch-loop"
     description = ("blocking host sync (np.asarray / .block_until_ready "
-                   "/ jax.device_get) inside a loop consuming a "
-                   "prefetched iterator")
+                   "/ jax.device_get), direct or routed through a "
+                   "helper call, inside a loop consuming a prefetched "
+                   "iterator")
 
-    # host-blocking calls that serialize the feed when they appear in
-    # the hot loop; float()/`.item()` on metrics is JX101's territory
-    # (traced code) — here the loop is host code, and the listed calls
-    # block unconditionally rather than per-element
-    _BLOCKING_ATTRS = {"block_until_ready", "device_get"}
+    # the blocking-call set is core.is_host_blocking_call (shared with
+    # the ProjectContext blocking-callable summary so direct and
+    # helper-routed syncs can never diverge); float()/`.item()` on
+    # metrics is JX101's territory (traced code) — here the loop is
+    # host code, and the matched calls block unconditionally rather
+    # than per-element
 
     def check(self, mod: ModuleContext) -> Iterator[Finding]:
-        prefetch = set(mod.cfg.prefetch_funcs)
         # names bound to a prefetch-factory result (`feed =
         # DevicePrefetcher(...)` then `for b in feed:` — the repo idiom);
         # module-coarse name tracking is plenty for a linter
@@ -729,13 +741,13 @@ class PrefetchLoopSyncChecker(Checker):
             value = getattr(node, "value", None)
             if isinstance(node, (ast.Assign, ast.AnnAssign)) \
                     and isinstance(value, ast.Call) \
-                    and last_attr(call_name(value)) in prefetch:
+                    and mod.call_is_prefetch_factory(value):
                 names.update(assign_target_names(node))
         flagged: set[int] = set()  # nested prefetch loops: report once
         for node in ast.walk(mod.tree):
             if not isinstance(node, (ast.For, ast.AsyncFor)):
                 continue
-            if not self._is_prefetch_iter(node.iter, prefetch, names):
+            if not self._is_prefetch_iter(node.iter, mod, names):
                 continue
             for stmt in node.body:
                 for sub in ast.walk(stmt):
@@ -748,12 +760,7 @@ class PrefetchLoopSyncChecker(Checker):
                     method = (sub.func.attr
                               if isinstance(sub.func, ast.Attribute)
                               else None)
-                    blocking = (
-                        name in _NP_MATERIALIZERS
-                        or last_attr(name) in self._BLOCKING_ATTRS
-                        or method in self._BLOCKING_ATTRS
-                    )
-                    if blocking:
+                    if is_host_blocking_call(sub):
                         flagged.add(id(sub))
                         label = name or f".{method}()"
                         yield mod.finding(
@@ -764,16 +771,30 @@ class PrefetchLoopSyncChecker(Checker):
                             "step while the host waits; fetch after the "
                             "loop (or batch via the pending/drain "
                             "pattern, train/trainer.py)")
+                        continue
+                    # interprocedural: the sync hides inside a helper
+                    helper = mod.call_blocks_host(sub)
+                    if helper is not None:
+                        flagged.add(id(sub))
+                        yield mod.finding(
+                            sub, self.code,
+                            f"'{name or helper}' blocks the host inside "
+                            "a prefetched-input loop (the helper "
+                            f"'{helper}' transitively calls np.asarray/"
+                            "block_until_ready/device_get): the async "
+                            "feed's queued H2D transfers stop "
+                            "overlapping the step; fetch after the loop "
+                            "(pending/drain pattern, train/trainer.py)")
 
     @staticmethod
-    def _is_prefetch_iter(expr: ast.AST, prefetch: set[str],
+    def _is_prefetch_iter(expr: ast.AST, mod: ModuleContext,
                           names: set[str]) -> bool:
         """True when the loop's iterable is (or wraps, e.g. via
         ``enumerate``/``zip``) a prefetch-factory call or a name bound
         to one."""
         for node in ast.walk(expr):
             if isinstance(node, ast.Call) \
-                    and last_attr(call_name(node)) in prefetch:
+                    and mod.call_is_prefetch_factory(node):
                 return True
             if isinstance(node, ast.Name) and node.id in names:
                 return True
@@ -1061,26 +1082,29 @@ class F32WireChecker(Checker):
     Which call names count as wire sinks is the ``wire_funcs`` knob
     (``jaxlint.toml``); non-image small tensors (labels, boxes) are
     cheap either way, but an f32 CAST feeding the wire is the
-    tell-tale of a pipeline normalizing on the host."""
+    tell-tale of a pipeline normalizing on the host.
+
+    Interprocedural (ISSUE 10): a helper that RETURNS an f32 cast is a
+    cast at its call sites (the ProjectContext f32-returner summary),
+    and a wrapper feeding its parameter into a wire sink is a sink for
+    its callers — the ``wire_funcs`` knob seeds the sink set, the
+    dataflow is the mechanism."""
 
     code = "JX114"
     name = "f32-pixels-on-the-wire"
     description = ("host-side .astype(np.float32)/np.asarray(x, f32) "
-                   "result fed to device_put/shard_batch/prefetcher "
-                   "(4x wire bytes; ship uint8, normalize on device)")
-
-    _CAST_CALLS = {"np.asarray", "np.array", "numpy.asarray",
-                   "numpy.array"}
+                   "result (direct or returned by a helper) fed to "
+                   "device_put/shard_batch/prefetcher (4x wire bytes; "
+                   "ship uint8, normalize on device)")
 
     def check(self, mod: ModuleContext) -> Iterator[Finding]:
-        wire = set(mod.cfg.wire_funcs)
         for info in mod.functions:
             if info.parent is not None:
                 continue  # nested defs scan with their parent
-            yield from self._scan(mod, info.node, wire)
+            yield from self._scan(mod, info.node)
 
-    def _scan(self, mod: ModuleContext, func: FunctionNode,
-              wire: set) -> Iterator[Finding]:
+    def _scan(self, mod: ModuleContext,
+              func: FunctionNode) -> Iterator[Finding]:
         from tools.jaxlint.core import assign_target_names
 
         # per-name assignment history (line, came-from-an-f32-cast):
@@ -1091,7 +1115,7 @@ class F32WireChecker(Checker):
         for node in ast.walk(func):
             if isinstance(node, (ast.Assign, ast.AnnAssign)) \
                     and getattr(node, "value", None) is not None:
-                cast = self._has_f32_cast(node.value)
+                cast = mod.expr_has_f32_source(node.value)
                 for name in assign_target_names(node):
                     assigns.setdefault(name, []).append(
                         (node.lineno, cast))
@@ -1107,11 +1131,10 @@ class F32WireChecker(Checker):
         for node in ast.walk(func):
             if not isinstance(node, ast.Call) or id(node) in flagged:
                 continue
-            la = last_attr(call_name(node))
-            if la not in wire:
+            if not mod.call_is_wire_sink(node):
                 continue
             for arg in list(node.args) + [k.value for k in node.keywords]:
-                direct = self._has_f32_cast(arg)
+                direct = mod.expr_has_f32_source(arg)
                 via_name = any(
                     isinstance(sub, ast.Name)
                     and tainted_at(sub.id, node.lineno)
@@ -1126,30 +1149,6 @@ class F32WireChecker(Checker):
                         "(ops/normalize.maybe_normalize + "
                         "data/device_aug.py)")
                     break
-
-    def _has_f32_cast(self, expr: ast.AST) -> bool:
-        for node in ast.walk(expr):
-            if not isinstance(node, ast.Call):
-                continue
-            if isinstance(node.func, ast.Attribute) \
-                    and node.func.attr == "astype" \
-                    and node.args \
-                    and self._is_f32(node.args[0]):
-                return True
-            if call_name(node) in self._CAST_CALLS:
-                vals = list(node.args[1:]) + [
-                    k.value for k in node.keywords if k.arg == "dtype"]
-                if any(self._is_f32(v) for v in vals):
-                    return True
-        return False
-
-    @staticmethod
-    def _is_f32(node: ast.AST) -> bool:
-        try:
-            text = ast.unparse(node)
-        except Exception:  # pragma: no cover - unparse is 3.9+
-            return False
-        return "float32" in text
 
 
 @register_checker

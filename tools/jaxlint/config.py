@@ -325,8 +325,153 @@ def load_config(path: str | Path | None) -> LintConfig:
             raise TomlError(
                 "baseline entries need at least 'path' and 'code': "
                 f"{entry!r}")
+        if not str(entry.get("reason", "")).strip():
+            # the ledger is a reviewed debt list, not a mute button:
+            # an exception nobody can justify is not an exception
+            raise TomlError(
+                "baseline entry for "
+                f"{entry['path']!r} {entry['code']!r} has no 'reason' — "
+                "every recorded exception must say why it is deliberate")
         cfg.baseline.append(BaselineEntry(
             path=entry["path"], code=entry["code"],
-            reason=entry.get("reason", ""), match=entry.get("match", ""),
+            reason=entry["reason"], match=entry.get("match", ""),
+        ))
+    return cfg
+
+
+# ---------------------------------------------------------- ircheck config
+
+
+@dataclass
+class DonationWaiver:
+    """A justified exception to the IR-level donation gate (JX104
+    enforcement): ``model``'s compiled step is allowed an undonated
+    state fraction up to ``max_undonated_fraction``. ``reason`` is
+    mandatory — the ledger burns down, it does not accrete."""
+
+    model: str
+    reason: str
+    max_undonated_fraction: float = 1.0
+    hits: int = 0  # filled by ircheck; stale waivers are warned about
+
+
+@dataclass
+class HbmBaseline:
+    """Recorded ``hbm_gb_per_step`` for one (model, platform, mesh,
+    batch) lowering — the regression ledger the ±tolerance gate compares
+    against, so the 76 GB class of numbers can only go down."""
+
+    model: str
+    platform: str  # jax backend the number was recorded on (cpu/tpu/...)
+    batch: int
+    hbm_gb_per_step: float
+    mesh: str = "1x1"
+    note: str = ""
+
+
+@dataclass
+class DtypeWaiver:
+    """A justified f32 pixel input on the H2D boundary of ``model``'s
+    step (the IR twin of JX114) — e.g. feeds with no uint8 source.
+    ``reason`` is mandatory."""
+
+    model: str
+    reason: str
+    hits: int = 0
+
+
+@dataclass
+class IRCheckConfig:
+    """Knobs + ledgers for the compiled-IR contract gate
+    (``tools/jaxlint/ircheck.py``), loaded from the ``[ircheck]`` table
+    and the ``[[ircheck.donation]]`` / ``[[ircheck.hbm]]`` /
+    ``[[ircheck.dtype]]`` arrays of ``jaxlint.toml``."""
+
+    # minimum donated fraction of state BYTES that must be aliased
+    # input->output in the compiled executable (JX104 enforcement)
+    donation_min_fraction: float = 0.99
+    # HBM ledger gate: fail when measured > baseline * (1 + tolerance);
+    # nudge to re-record when measured < baseline * (1 - tolerance)
+    hbm_tolerance: float = 0.05
+    # ircheck CASE names cheap enough for the tier-1/`make check`
+    # subset (a case may cover several registry entries, e.g. "dcgan")
+    fast_models: list[str] = field(default_factory=lambda: [
+        "lenet5", "lenet5_tf", "dcgan",
+    ])
+    donation: list[DonationWaiver] = field(default_factory=list)
+    hbm: list[HbmBaseline] = field(default_factory=list)
+    dtype: list[DtypeWaiver] = field(default_factory=list)
+
+    def hbm_baseline(self, model: str, platform: str, mesh: str,
+                     batch: int) -> HbmBaseline | None:
+        for b in self.hbm:
+            if (b.model, b.platform, b.mesh, b.batch) == \
+                    (model, platform, mesh, batch):
+                return b
+        return None
+
+    def donation_waiver(self, model: str) -> DonationWaiver | None:
+        for w in self.donation:
+            if w.model == model:
+                return w
+        return None
+
+    def dtype_waiver(self, model: str) -> DtypeWaiver | None:
+        for w in self.dtype:
+            if w.model == model:
+                return w
+        return None
+
+
+def load_ircheck_config(path: str | Path | None) -> IRCheckConfig:
+    """Build an IRCheckConfig from ``jaxlint.toml`` (defaults if
+    absent). Donation/dtype waivers without a ``reason`` are rejected —
+    same contract as the ``[[baseline]]`` ledger."""
+    cfg = IRCheckConfig()
+    if path is None:
+        return cfg
+    path = Path(path)
+    if not path.exists():
+        return cfg
+    data = loads_toml(path.read_text())
+    table = data.get("ircheck", {})
+    for name in ("donation_min_fraction", "hbm_tolerance"):
+        if name in table:
+            setattr(cfg, name, float(table[name]))
+    if "fast_models" in table:
+        cfg.fast_models = [str(x) for x in table["fast_models"]]
+    for entry in table.get("donation", []):
+        if "model" not in entry:
+            raise TomlError(f"ircheck.donation entry needs 'model': {entry!r}")
+        if not str(entry.get("reason", "")).strip():
+            raise TomlError(
+                f"ircheck.donation waiver for {entry['model']!r} has no "
+                "'reason' — every donation exception must say why")
+        cfg.donation.append(DonationWaiver(
+            model=entry["model"], reason=entry["reason"],
+            max_undonated_fraction=float(
+                entry.get("max_undonated_fraction", 1.0)),
+        ))
+    for entry in table.get("hbm", []):
+        for req in ("model", "platform", "batch", "hbm_gb_per_step"):
+            if req not in entry:
+                raise TomlError(
+                    f"ircheck.hbm baseline needs {req!r}: {entry!r}")
+        cfg.hbm.append(HbmBaseline(
+            model=entry["model"], platform=entry["platform"],
+            batch=int(entry["batch"]),
+            hbm_gb_per_step=float(entry["hbm_gb_per_step"]),
+            mesh=str(entry.get("mesh", "1x1")),
+            note=str(entry.get("note", "")),
+        ))
+    for entry in table.get("dtype", []):
+        if "model" not in entry:
+            raise TomlError(f"ircheck.dtype entry needs 'model': {entry!r}")
+        if not str(entry.get("reason", "")).strip():
+            raise TomlError(
+                f"ircheck.dtype waiver for {entry['model']!r} has no "
+                "'reason' — every f32-pixel exception must say why")
+        cfg.dtype.append(DtypeWaiver(
+            model=entry["model"], reason=entry["reason"],
         ))
     return cfg

@@ -96,7 +96,10 @@ def main():
     trace_dir = f"/tmp/profile_{model}_b{batch}"
 
     state, db, compiled = build(model, batch)
-    ca = compiled.cost_analysis()
+    # version-normalized cost analysis (dict vs 0.4.x list-of-dicts)
+    from tools.hbm_budget import cost_analysis_dict
+
+    ca = cost_analysis_dict(compiled)
     flops = ca.get("flops", 0.0)
     hbm = ca.get("bytes accessed", 0.0)
     print(json.dumps({
