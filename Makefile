@@ -174,11 +174,38 @@ chaos-dist-smoke:
 	grep -q "hosts=1/2" "$$L" && \
 	echo "chaos-dist-smoke OK (coordinated preempt + elastic resume on the survivor)"
 
+# SDC chaos smoke (silent-failure defense, resilience/sentinel.py): a
+# REAL 2-process CPU cluster with `--sentinel` audits every 8 steps
+# and a SILENT sdc_grad corruption (one leaf scaled by 1+2^-10 — no
+# NaN, no loss spike) injected on host 1 at run step 20. Asserts the
+# full kill chain: cross-host fingerprint divergence at audit step 24
+# (detection latency 4 <= K=8), generation teardown, ONE replay
+# (= ceil(log2 2)) of the clean host re-deriving the ground truth,
+# host 1 quarantined into the excluded-hosts ledger, elastic
+# completion on the survivor, and the grep-stable `[sentinel]` exit
+# line with trips=0 (the z-score must NOT fire on a silent fault —
+# that is the audit's job)
+chaos-sdc-smoke:
+	@mkdir -p logs; L="logs/chaos-sdc-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	rm -rf runs/chaos-sdc-smoke; \
+	$(PY) train_dist.py --supervise 2 --platform cpu \
+		--barrier-lead 3 --barrier-timeout-s 60 \
+		--straggler-after-s 60 --heartbeat-timeout-s 300 \
+		--init-timeout-s 120 --faults sdc_grad@20:host1 \
+		-m lenet5 --epochs 2 --synthetic-size 2048 --batch-size 64 \
+		--steps-per-epoch 16 --sentinel --audit-every 8 \
+		--workdir runs/chaos-sdc-smoke 2>&1 | tee "$$L" && \
+	grep -q "fingerprints disagree at audit step 24" "$$L" && \
+	grep -q "QUARANTINED host 1" "$$L" && \
+	grep -q "gen 1: launching hosts \[0\]" "$$L" && \
+	grep -qE "\[sentinel\] trips=0 audits=[0-9]+ divergences=1 quarantined=1" "$$L" && \
+	echo "chaos-sdc-smoke OK (silent SDC caught <= K, host 1 quarantined by replay bisection, survivor completed)"
+
 # the default CI path: hazard lint + serving smoke + chaos smoke +
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke router-smoke obs-smoke chaos-smoke chaos-dist-smoke feed-smoke
+check: lint serve-smoke router-smoke obs-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -302,4 +329,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-ir bf16-ready check serve-smoke router-smoke obs-smoke feed-smoke chaos-dist-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-ir bf16-ready check serve-smoke router-smoke obs-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke bench dryrun tensorboard find-python list-models rehearsal

@@ -312,3 +312,45 @@ def default_registry() -> Registry:
     by default — the single source for ``GET /metrics`` and the bench
     JSON's ``obs`` block."""
     return _DEFAULT
+
+
+def start_exposition_server(port: int, registry: Registry | None = None,
+                            host: str = "0.0.0.0"):
+    """Minimal standalone Prometheus scrape surface: a daemon-threaded
+    stdlib HTTP server answering ``GET /metrics`` with
+    :meth:`Registry.render_prometheus` (plus ``/healthz``). Exists for
+    processes that are NOT already serving HTTP — the multi-host
+    training supervisor (``train_dist.py --supervise --metrics-port``)
+    most of all; ``serve.py`` keeps its own integrated endpoint.
+
+    Returns ``(server, actual_port)``; call ``server.shutdown()`` to
+    stop. ``port=0`` binds an ephemeral port (tests)."""
+    import http.server
+    import threading
+
+    reg = registry if registry is not None else default_registry()
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path.split("?")[0] == "/metrics":
+                body = reg.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/healthz":
+                body, ctype = b"ok\n", "text/plain"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes are not log events
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, int(port)), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="metrics-exposition")
+    thread.start()
+    return server, server.server_address[1]

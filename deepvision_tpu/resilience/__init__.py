@@ -23,15 +23,29 @@ fault-injection harness needed to TEST it on CPU:
                  per epoch through ``train/loggers.Loggers``).
 - ``cluster``  : preemption-tolerant MULTI-HOST training —
                  :class:`ClusterMember` (heartbeats + the coordinated
-                 save-barrier protocol the Trainer speaks),
+                 save-barrier protocol the Trainer speaks + the
+                 cross-host state-agreement audit files),
                  :class:`HostLedger` (liveness/straggler view, obs
                  gauges), and :class:`ClusterSupervisor`
                  (``train_dist.py --supervise N``: watch, deliver/
                  absorb preemptions, relaunch on the surviving host
-                 set with deterministic elastic resume). Imported
-                 lazily by consumers — it is NOT re-exported here so
+                 set with deterministic elastic resume — and, on an
+                 SDC verdict, attribute the culprit by replay
+                 bisection and quarantine it). Imported lazily by
+                 consumers — it is NOT re-exported here so
                  ``import deepvision_tpu.resilience`` stays cheap for
                  the serve/data layers.
+- ``sentinel`` : SILENT-failure defense — :func:`sentinel_step`
+                 (in-graph numeric invariants riding the step's
+                 metrics pytree), :class:`EwmaDetector` /
+                 :class:`SentinelMonitor` (z-score anomaly detection
+                 on the drain cadence, trips feed the rollback),
+                 :func:`tree_fingerprint` (seeded random-projection
+                 state fingerprint: the cross-host agreement audit
+                 and the audited checkpoint manifests), and
+                 :func:`apply_sdc` (the deterministic corruption
+                 drills). Imported lazily for the same reason as
+                 ``cluster``.
 
 Consumers: ``train/trainer.py`` (NaN tripwire -> checkpoint rollback +
 batch-window skip), ``train/checkpoint.py`` (per-save checksum

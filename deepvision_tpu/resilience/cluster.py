@@ -107,6 +107,15 @@ ENV_HOST = "DVTPU_CLUSTER_HOST"
 ENV_NHOSTS = "DVTPU_CLUSTER_NHOSTS"
 ENV_LEAD = "DVTPU_CLUSTER_BARRIER_LEAD"
 ENV_TIMEOUT = "DVTPU_CLUSTER_BARRIER_TIMEOUT"
+# the process's ORIGINAL host id — stable across elastic relaunches
+# (generation indices are not), so ':hostH'-targeted sdc drills and the
+# quarantine ledger name the same physical host forever
+ENV_ORIG_HOST = "DVTPU_CLUSTER_ORIG_HOST"
+# replay-bisection mode: train deterministically to this RUN step
+# (auditing on the way), then exit 0 without saving — the audit files
+# are the replay's verdict (resilience/sentinel.py module docstring)
+ENV_REPLAY = "DVTPU_SENTINEL_REPLAY"
+ENV_QUIESCE = "DVTPU_SDC_QUIESCE"
 
 
 def _atomic_write_json(path: Path, obj: dict) -> None:
@@ -168,6 +177,8 @@ class ClusterMember:
         self._last_beat = 0.0
         self._last_epoch = -1
         self._barrier_cache: dict | None = None
+        self._own_audits: dict[int, dict] = {}
+        self._audits_compared: set[int] = set()
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "ClusterMember | None":
@@ -281,6 +292,76 @@ class ClusterMember:
                 if (r := _read_json(
                     self.directory / f"commit-{h}.json")) is not None]
 
+    # -- cross-host state-agreement audit (silent-failure defense) -------
+    def record_audit(self, step: int, fp: dict) -> dict | None:
+        """Publish this host's state fingerprint for audit ``step`` and
+        compare every audit step for which ALL hosts have now
+        published (lag-tolerant: a host ahead of its peers banks its
+        own audits and compares them as the peer files land — file
+        reads only, never a device fetch, so auditing can never wedge
+        a peer's collectives). Returns ``{"step", "fps"}`` on the
+        FIRST step whose fingerprints disagree, else None."""
+        _atomic_write_json(
+            self.directory / f"audit-{self.host}-{int(step)}.json",
+            {"host": self.host, "step": int(step), **fp})
+        self._own_audits[int(step)] = fp
+        return self._compare_pending()
+
+    def _compare_pending(self) -> dict | None:
+        for step in sorted(self._own_audits):
+            if step in self._audits_compared:
+                continue
+            fps = {self.host: self._own_audits[step]}
+            for h in range(self.nhosts):
+                if h == self.host:
+                    continue
+                rec = _read_json(
+                    self.directory / f"audit-{h}-{step}.json")
+                if rec is None:
+                    return None  # compare strictly in step order
+                fps[h] = rec
+            self._audits_compared.add(step)
+            if len({f["digest"] for f in fps.values()}) > 1:
+                return {"step": step, "fps": fps}
+        return None
+
+    def final_audit_check(self, *, timeout_s: float = 10.0
+                          ) -> dict | None:
+        """Bounded end-of-run sweep: wait for peers' outstanding audit
+        files so a divergence published at the very last audit step is
+        still caught before this host exits cleanly. Timeout degrades
+        to no-verdict (a dead peer is the liveness ledger's problem,
+        not the audit's)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            div = self._compare_pending()
+            if div is not None:
+                return div
+            if set(self._own_audits) <= self._audits_compared:
+                return None  # everything compared clean
+            if time.monotonic() >= deadline:
+                return None
+            self.beat(0, status="audit")
+            time.sleep(0.05)
+
+    def write_divergence(self, div: dict) -> None:
+        """First-writer-wins divergence marker — the supervisor's
+        signal that this generation ended in an SDC, with the per-host
+        fingerprints attribution starts from."""
+        _create_once_json(self.directory / "sdc-divergence.json",
+                          {"by": self.host, **div,
+                           "fps": {str(h): fp
+                                   for h, fp in div["fps"].items()}})
+
+    def write_trip(self, step: int, key: str, value: float,
+                   z: float) -> None:
+        """Self-identified sentinel trip marker: the host caught its
+        OWN state misbehaving, so attribution needs no bisection."""
+        _atomic_write_json(
+            self.directory / f"sdc-trip-{self.host}.json",
+            {"host": self.host, "step": int(step), "key": key,
+             "value": float(value), "z": float(z)})
+
 
 class HostLedger:
     """Supervisor-side view of the heartbeat files + the obs gauges
@@ -355,6 +436,7 @@ class ClusterSupervisor:
                  max_relaunches: int = 3,
                  barrier_lead: int = BARRIER_LEAD,
                  barrier_timeout_s: float = 30.0,
+                 replay_timeout_s: float = 900.0,
                  env: dict | None = None,
                  worker_cmd=None,
                  registry=None,
@@ -384,7 +466,17 @@ class ClusterSupervisor:
         self._c = {k: reg.counter(f"cluster_{k}")
                    for k in ("preemptions", "resumes", "stragglers",
                              "host_deaths")}
+        # silent-failure defense (resilience/sentinel.py): SDC audit /
+        # quarantine counters, surfaced on --metrics-port and in the
+        # grep-stable `[sentinel] trips=... ` exit line
+        self._s = {k: reg.counter(f"sentinel_{k}")
+                   for k in ("trips", "audits", "divergences",
+                             "quarantined")}
+        self.replay_timeout_s = float(replay_timeout_s)
+        self._replay_n = 0
+        self._scanned_dirs: set[Path] = set()
         self.cluster_root = self.workdir / "cluster"
+        self.excluded_ledger = self.workdir / "excluded_hosts.json"
 
     # -- worker launching ------------------------------------------------
     def _default_worker_cmd(self, ctx: dict) -> list[str]:
@@ -400,8 +492,9 @@ class ClusterSupervisor:
             cmd += ["--resume"]
         return cmd
 
-    def _spawn(self, gen_dir: Path, hosts: list[int],
-               resume: bool) -> dict[int, subprocess.Popen]:
+    def _spawn(self, gen_dir: Path, hosts: list[int], resume: bool,
+               extra_env: dict | None = None
+               ) -> dict[int, subprocess.Popen]:
         port = _free_port()
         procs: dict[int, subprocess.Popen] = {}
         for index, host in enumerate(hosts):
@@ -412,8 +505,10 @@ class ClusterSupervisor:
                    ENV_DIR: str(gen_dir),
                    ENV_HOST: str(index),
                    ENV_NHOSTS: str(len(hosts)),
+                   ENV_ORIG_HOST: str(host),
                    ENV_LEAD: str(self.barrier_lead),
-                   ENV_TIMEOUT: str(self.barrier_timeout_s)}
+                   ENV_TIMEOUT: str(self.barrier_timeout_s),
+                   **(extra_env or {})}
             p = subprocess.Popen(
                 self._worker_cmd(ctx), env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -484,10 +579,27 @@ class ClusterSupervisor:
         last_step = 0
         start = time.monotonic()
         dead: set[int] = set()
+        sdc_seen = False
         while any(p.poll() is None for p in procs.values()):
             time.sleep(self.poll_s)
             now = time.time()
             hb = ledger.publish(now, fresh_s=self.straggler_after_s)
+            if not sdc_seen and (
+                    (gen_dir / "sdc-divergence.json").exists()
+                    or any(True for _ in gen_dir.glob(
+                        "sdc-trip-*.json"))):
+                # an SDC verdict is out: the detecting host exits 76
+                # and every peer's next collective would wedge on its
+                # missing dispatches — tear the generation down NOW
+                # and move to attribution
+                sdc_seen = True
+                self.log("[cluster] SDC verdict published; tearing "
+                         "down the generation for attribution",
+                         flush=True)
+                for q in procs.values():
+                    if q.poll() is None:
+                        q.kill()
+                continue
             last_step = self._consult_faults(
                 procs, last_step,
                 max([r.get("step", 0) for r in hb.values()], default=0),
@@ -533,6 +645,13 @@ class ClusterSupervisor:
         codes = {i: p.returncode for i, p in procs.items()}
         self.log(f"[cluster] gen {gen} exit codes: {codes}", flush=True)
         removed = {hosts[i] for i in preempt_pending}
+        self._scan_sentinel(gen_dir)
+        if (gen_dir / "sdc-divergence.json").exists() \
+                or list(gen_dir.glob("sdc-trip-*.json")):
+            # an SDC verdict outranks every other classification: a
+            # peer that ALSO went heartbeat-silent was almost certainly
+            # wedged on the detector's abandoned collectives
+            return "sdc", removed
         if dead:
             return "dead", removed
         if all(c == 0 for c in codes.values()):
@@ -583,6 +702,218 @@ class ClusterSupervisor:
                 return True
         return False
 
+    # -- SDC attribution: replay bisection + quarantine ------------------
+    def _scan_sentinel(self, d: Path) -> None:
+        """Fold one generation/replay dir's sentinel artifacts into the
+        counters (idempotent per directory)."""
+        if d in self._scanned_dirs or not d.exists():
+            return
+        self._scanned_dirs.add(d)
+        audits = {f.name for f in d.glob("audit-*.json")}
+        trips = list(d.glob("sdc-trip-*.json"))
+        if audits:
+            self._s["audits"].inc(len(audits))
+        if trips:
+            self._s["trips"].inc(len(trips))
+        if (d / "sdc-divergence.json").exists():
+            self._s["divergences"].inc()
+
+    def _replay(self, probe: list[int],
+                until: int) -> tuple[str, dict | None]:
+        """Re-run the suspect window on the host subset ``probe`` (from
+        the newest commonly-verified checkpoint, sdc injection
+        quiesced) and read the verdict from its audit artifacts:
+
+        - ``("dirty", None)``  — the replay itself tripped a sentinel
+          or internally diverged (a sticky fault lives in ``probe``);
+        - ``("clean", fp)``    — the subset agreed through the window;
+          ``fp`` is the replayed ground-truth fingerprint at ``until``;
+        - ``("failed", None)`` — no verdict (crash/timeout): treated as
+          dirty by the caller, which keeps attribution conservative.
+        """
+        self._replay_n += 1
+        rdir = self.cluster_root / f"replay-{self._replay_n:03d}"
+        rdir.mkdir(parents=True, exist_ok=True)
+        self.log(f"[sentinel] replay {self._replay_n}: hosts {probe} "
+                 f"through run step {until} (quiesced, from the newest "
+                 "verified checkpoint)", flush=True)
+        self._degraded_cleanup()
+        procs = self._spawn(rdir, probe, self._has_checkpoint(),
+                            extra_env={ENV_REPLAY: str(until),
+                                       ENV_QUIESCE: "1"})
+        deadline = time.monotonic() + self.replay_timeout_s
+        while any(p.poll() is None for p in procs.values()):
+            if (rdir / "sdc-divergence.json").exists() \
+                    or any(True for _ in rdir.glob("sdc-trip-*.json")):
+                # dirty verdict: stop burning compute, the surviving
+                # replay peers would wedge on dead collectives anyway
+                for p in procs.values():
+                    if p.poll() is None:
+                        p.kill()
+            if time.monotonic() >= deadline:
+                self.log("[sentinel] replay timed out; killing it",
+                         flush=True)
+                for p in procs.values():
+                    if p.poll() is None:
+                        p.kill()
+            time.sleep(self.poll_s)
+        for p in procs.values():
+            p.wait()
+        self._scan_sentinel(rdir)
+        if (rdir / "sdc-divergence.json").exists() \
+                or list(rdir.glob("sdc-trip-*.json")):
+            return "dirty", None
+        fps = [_read_json(rdir / f"audit-{i}-{until}.json")
+               for i in range(len(probe))]
+        if any(fp is None for fp in fps):
+            return "failed", None
+        if len({fp["digest"] for fp in fps}) > 1:
+            return "dirty", None  # internal disagreement, unmarked
+        return "clean", fps[0]
+
+    def _attribute_against(self, fps: dict[int, dict],
+                           truth: dict) -> list[int]:
+        """Hosts whose original audit fingerprint disagrees with the
+        replayed ground truth. Exact digests first (a bit-identical
+        replay — same host count — isolates the culprit exactly); when
+        the replay ran on a DIFFERENT host count, reduction-order and
+        low-precision rounding noise makes every digest differ, so
+        attribution becomes a noise-floor ratio test: the cleanest
+        host's deviation IS the replay noise (it hits every comparison
+        equally), and hosts sitting ATTRIBUTION_RATIO above it carry
+        direct corruption. Empty = ambiguous — quarantine nothing
+        blind."""
+        from deepvision_tpu.resilience.sentinel import (
+            ATTRIBUTION_RATIO,
+            fingerprint_deviation,
+            fingerprints_agree,
+        )
+
+        exact = sorted(h for h, fp in fps.items()
+                       if not fingerprints_agree(fp, truth))
+        if exact and len(exact) < len(fps):
+            return exact
+        devs = {h: fingerprint_deviation(fp, truth)
+                for h, fp in fps.items()}
+        floor = min(devs.values())
+        self.log("[sentinel] attribution deviations vs replayed "
+                 "truth: "
+                 + " ".join(f"host{h}={d:.3g}"
+                            for h, d in sorted(devs.items()))
+                 + f" (noise floor {floor:.3g})", flush=True)
+        over = sorted(h for h, d in devs.items()
+                      if d > floor * ATTRIBUTION_RATIO + 1e-12)
+        if over and len(over) < len(devs):
+            return over
+        return []
+
+    def _quarantine_sdc(self, gen_dir: Path,
+                        hosts: list[int]) -> list[int]:
+        """Attribute a detected SDC to culprit host(s) and persist the
+        excluded-hosts ledger. Attribution ladder:
+
+        1. self-identified trips (a host's own z-score caught its
+           corrupted state) — no replay needed;
+        2. strict fingerprint majority at the divergent audit step —
+           the minority computed garbage;
+        3. replay bisection: binary-search the suspect set with
+           deterministic window replays (≤ ceil(log2 N) replays — a
+           clean replay's fingerprint is ground truth and attributes
+           everyone at once; a dirty one halves the suspects).
+        """
+        import math as _math
+
+        tripped = sorted(
+            hosts[rec["host"]]
+            for f in gen_dir.glob("sdc-trip-*.json")
+            if (rec := _read_json(f)) is not None
+            and rec["host"] < len(hosts))
+        if tripped:
+            self._exclude(tripped, reason="self-identified sentinel "
+                          "trip", replays=0)
+            return tripped
+        div = _read_json(gen_dir / "sdc-divergence.json")
+        if div is None:
+            return []
+        step = int(div["step"])
+        fps = {hosts[int(i)]: fp for i, fp in div["fps"].items()
+               if int(i) < len(hosts)}
+        by_digest: dict[str, list[int]] = {}
+        for h, fp in fps.items():
+            by_digest.setdefault(fp["digest"], []).append(h)
+        majority = max(by_digest.values(), key=len)
+        if len(majority) * 2 > len(fps):
+            culprits = sorted(h for h in fps if h not in majority)
+            self._exclude(culprits, reason=f"fingerprint minority at "
+                          f"audit step {step}", replays=0, step=step)
+            return culprits
+        # no majority (e.g. a 2-host fleet): replay bisection. A probe
+        # that stays internally consistent yields the ground-truth
+        # fingerprint (deterministic elastic replay) and attributes
+        # everyone at once; a probe that trips or internally diverges
+        # contains the (sticky) fault and halves the suspect set —
+        # single-fault assumption, the standard bisection contract. A
+        # would-be singleton probe rides with an already-exonerated
+        # host so a sticky culprit still shows up as INTERNAL
+        # disagreement instead of masquerading as ground truth (with
+        # nobody exonerated yet — a 2-host fleet's first replay — a
+        # deterministic sticky fault is formally unattributable; the
+        # transient-SDC model, the common real-world case, is).
+        suspects = sorted(fps)
+        exonerated: list[int] = []
+        budget = max(1, _math.ceil(_math.log2(max(2, len(suspects)))))
+        replays = 0
+        while len(suspects) > 1 and replays < budget:
+            half = suspects[:(len(suspects) + 1) // 2]
+            probe = (half if len(half) > 1 or not exonerated
+                     else [half[0], exonerated[0]])
+            verdict, truth = self._replay(probe, step)
+            replays += 1
+            if verdict == "failed":
+                self.log("[sentinel] replay produced no verdict "
+                         "(crash/timeout); aborting attribution rather "
+                         "than quarantining on a broken replay",
+                         flush=True)
+                return []
+            if verdict == "clean":
+                culprits = self._attribute_against(fps, truth)
+                if culprits:
+                    self._exclude(culprits, reason="fingerprint "
+                                  "mismatch vs replayed ground truth",
+                                  replays=replays, step=step)
+                    return culprits
+                self.log("[sentinel] replay matched every original "
+                         "fingerprint — divergence did not reproduce; "
+                         "quarantining nothing", flush=True)
+                return []
+            # dirty: the fault is in the probed half; the other half
+            # is exonerated under the single-fault assumption
+            exonerated.extend(h for h in suspects if h not in half)
+            suspects = half
+        if len(suspects) == 1:
+            self._exclude(suspects, reason="replay bisection",
+                          replays=replays, step=step)
+            return suspects
+        self.log(f"[sentinel] attribution ambiguous after {replays} "
+                 f"replays (suspects {suspects}); NOT quarantining "
+                 "blind — operator intervention required", flush=True)
+        return []
+
+    def _exclude(self, culprits: list[int], *, reason: str,
+                 replays: int, step: int | None = None) -> None:
+        ledger = _read_json(self.excluded_ledger) or {"excluded": []}
+        for h in culprits:
+            ledger["excluded"].append(
+                {"host": int(h), "reason": reason,
+                 "replays": int(replays),
+                 **({"step": int(step)} if step is not None else {}),
+                 "time": time.time()})
+            self._s["quarantined"].inc()
+            self.log(f"[sentinel] QUARANTINED host {h} ({reason}; "
+                     f"{replays} replay(s)); ledger: "
+                     f"{self.excluded_ledger}", flush=True)
+        _atomic_write_json(self.excluded_ledger, ledger)
+
     # -- the supervising loop --------------------------------------------
     def run(self) -> int:
         hosts = list(range(self.num_hosts))
@@ -601,6 +932,28 @@ class ClusterSupervisor:
                              "left to resume on", flush=True)
                     rc = 1
                     break
+            elif outcome == "sdc":
+                culprits = self._quarantine_sdc(
+                    self.cluster_root / f"gen-{gen:03d}", hosts)
+                if not culprits:
+                    self.log("[cluster] SDC detected but not "
+                             "attributed; refusing to continue on a "
+                             "fleet with a known-corrupt member",
+                             flush=True)
+                    rc = 1
+                    break
+                # drop quarantined hosts AND any host that was already
+                # holding a preemption notice when the SDC verdict
+                # outranked the generation's classification — its
+                # machine is leaving either way
+                hosts = [h for h in hosts
+                         if h not in culprits and h not in removed]
+                if not hosts:
+                    self.log("[cluster] every host quarantined; "
+                             "nothing trustworthy left to resume on",
+                             flush=True)
+                    rc = 1
+                    break
             else:  # crashed / heartbeat-dead
                 if relaunches_left <= 0:
                     self.log("[cluster] relaunch budget exhausted; "
@@ -616,5 +969,11 @@ class ClusterSupervisor:
             "[cluster] "
             + " ".join(f"{k}={c.value}" for k, c in self._c.items())
             + f" hosts={len(hosts)}/{self.num_hosts} generations={gen + 1}",
+            flush=True)
+        # grep-stable silent-failure summary (zeros when sentinels are
+        # off — the line's PRESENCE is part of the exit contract)
+        self.log(
+            "[sentinel] "
+            + " ".join(f"{k}={c.value}" for k, c in self._s.items()),
             flush=True)
         return rc

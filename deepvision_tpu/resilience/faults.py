@@ -58,7 +58,34 @@ Sites and their consultation points:
                     decode worker whose turn it is — the bounded
                     respawn-at-shard-position path runs.
                     Alias: ``wkill``.
+``sdc_grad``        silent data corruption of the update: at RUN step
+                    AT (epoch-anchored ``epoch*steps_per_epoch+step``,
+                    NOT a consult counter — so a resumed or replayed
+                    window re-fires at the same point bit-identically)
+                    the Trainer scales one parameter leaf of THIS
+                    host's replica by ``:ARG`` (default the silent
+                    ``sentinel.SDC_GRAD_SCALE``); ``:hostH`` instead
+                    targets original cluster host H only. Detected by
+                    the sentinel z-score (loud scales) or the
+                    cross-host agreement audit (silent scales).
+                    Alias: ``sdc``.
+``sdc_param``       silent single-bit corruption: at RUN step AT, XOR
+                    the low mantissa bit of one element of one
+                    parameter leaf on the targeted host — the one-ulp
+                    SDC only the fingerprint audit can see.
+                    Alias: ``sdcp``.
 ==================  =====================================================
+
+The sdc sites accept ``:hostH`` (e.g. ``sdc_grad@20:host1``) in the
+ARG slot: the spec then fires only in the process whose ORIGINAL
+cluster host id (``FaultInjector(host=...)``, exported by the
+supervisor as ``DVTPU_CLUSTER_ORIG_HOST``) matches — host ids are
+stable across elastic relaunches, so a quarantined host's fault can
+never follow the job onto a survivor. ``FaultInjector(sdc_quiesce=
+True)`` (supervisor replay generations) disarms the sdc sites: the
+replay models re-running the window on hardware that is not
+misbehaving, which is what makes the replayed fingerprint the ground
+truth the bisection attributes against.
 
 Example: ``"nan@14,ckpt@1,io@8x2"`` — NaN-poison the 15th train batch,
 corrupt the 2nd checkpoint save, and fail the 9th and 10th data pulls
@@ -67,6 +94,7 @@ with transient read errors.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from dataclasses import dataclass, field
@@ -88,11 +116,15 @@ __all__ = [
 # canonical site names + accepted aliases
 SITES = ("nan_step", "data_io", "ckpt_corrupt", "stall", "dispatch_crash",
          "replica_kill", "replica_slow", "host_preempt", "host_stall",
-         "worker_kill")
+         "worker_kill", "sdc_grad", "sdc_param")
 # the sites the CLUSTER SUPERVISOR consults (resilience/cluster.py);
 # train_dist.py splits a mixed schedule on this set so supervisor-level
 # specs never reach the in-job injector (and vice versa)
 CLUSTER_SITES = ("host_preempt", "host_stall")
+# RUN-step-keyed sites (fired by step VALUE, not consult occurrence):
+# deterministic under resume/replay from any point, the property the
+# supervisor's replay bisection leans on
+SDC_SITES = ("sdc_grad", "sdc_param")
 _ALIASES = {
     "nan": "nan_step", "nan_grad": "nan_step",
     "io": "data_io",
@@ -103,7 +135,10 @@ _ALIASES = {
     "preempt": "host_preempt",
     "hstall": "host_stall",
     "wkill": "worker_kill",
+    "sdc": "sdc_grad",
+    "sdcp": "sdc_param",
 }
+_HOST_ARG = re.compile(r"^host(\d+)$")
 
 
 class InjectedIOError(IOError):
@@ -126,6 +161,7 @@ class FaultSpec:
     times: int = 1
     prob: float | None = None
     arg: float | None = None
+    host: int | None = None
     fired: int = field(default=0, compare=False)
 
     def __post_init__(self):
@@ -143,6 +179,17 @@ class FaultSpec:
         if self.times < 1:
             raise ValueError(f"{self.kind}: times must be >= 1, "
                              f"got {self.times}")
+        if self.host is not None and self.kind not in SDC_SITES:
+            raise ValueError(
+                f"{self.kind}: ':hostH' targeting only applies to the "
+                f"sdc sites {SDC_SITES}")
+        if self.kind in SDC_SITES and self.prob is not None:
+            # step-keyed sites are replay-deterministic BY DEFINITION;
+            # a probabilistic draw per observed step would break the
+            # bisection's ground-truth contract
+            raise ValueError(
+                f"{self.kind}: sdc sites are run-step-keyed "
+                "(kind@STEP only; kind~PROB is not replayable)")
 
     def should_fire(self, occurrence: int, rng) -> bool:
         if self.prob is not None:
@@ -157,14 +204,19 @@ def parse_schedule(spec: str) -> list[FaultSpec]:
         raw = raw.strip()
         if not raw:
             continue
-        arg = None
+        arg = host = None
         if ":" in raw:
             raw, _, argtok = raw.partition(":")
-            try:
-                arg = float(argtok)
-            except ValueError:
-                raise ValueError(
-                    f"fault spec {raw!r}: bad :ARG value {argtok!r}")
+            m = _HOST_ARG.match(argtok.strip())
+            if m:  # sdc host targeting: sdc_grad@20:host1
+                host = int(m.group(1))
+            else:
+                try:
+                    arg = float(argtok)
+                except ValueError:
+                    raise ValueError(
+                        f"fault spec {raw!r}: bad :ARG value {argtok!r}"
+                        " (want a float, or hostH for the sdc sites)")
         if "@" in raw:
             kind, _, attok = raw.partition("@")
             times = 1
@@ -173,7 +225,7 @@ def parse_schedule(spec: str) -> list[FaultSpec]:
                 times = _parse_int(timestok, raw, "xTIMES")
             out.append(FaultSpec(kind=kind.strip(),
                                  at=_parse_int(attok, raw, "@AT"),
-                                 times=times, arg=arg))
+                                 times=times, arg=arg, host=host))
         elif "~" in raw:
             kind, _, ptok = raw.partition("~")
             try:
@@ -181,7 +233,8 @@ def parse_schedule(spec: str) -> list[FaultSpec]:
             except ValueError:
                 raise ValueError(f"fault spec {raw!r}: bad ~PROB "
                                  f"value {ptok!r}") from None
-            out.append(FaultSpec(kind=kind.strip(), prob=prob, arg=arg))
+            out.append(FaultSpec(kind=kind.strip(), prob=prob, arg=arg,
+                                 host=host))
         else:
             raise ValueError(
                 f"fault spec {raw!r}: expected kind@AT[xN][:ARG] "
@@ -198,7 +251,9 @@ def format_spec(spec: FaultSpec) -> str:
         s = f"{spec.kind}@{spec.at}"
         if spec.times > 1:
             s += f"x{spec.times}"
-    if spec.arg is not None:
+    if spec.host is not None:
+        s += f":host{spec.host}"
+    elif spec.arg is not None:
         s += f":{spec.arg:g}"
     return s
 
@@ -254,14 +309,23 @@ class FaultInjector:
     """
 
     def __init__(self, schedule: str | list[FaultSpec] | None,
-                 *, seed: int = 0):
+                 *, seed: int = 0, host: int | None = None,
+                 sdc_quiesce: bool = False):
         if isinstance(schedule, str):
             schedule = parse_schedule(schedule)
         self.specs: list[FaultSpec] = list(schedule or [])
         self._rng = np.random.default_rng(seed)
         self._counts: dict[str, int] = {s: 0 for s in SITES}
         self._lock = threading.Lock()
-        self.fired: list[tuple[str, int]] = []  # (site, occurrence)
+        self.fired: list[tuple[str, int]] = []  # (site, occurrence/step)
+        # this process's ORIGINAL cluster host id (stable across
+        # elastic relaunches) for ':hostH'-targeted sdc specs; None =
+        # single-host / untargeted
+        self.host = host
+        # replay generations run with the sdc sites disarmed: the
+        # replayed window is the bisection's ground truth
+        self.sdc_quiesce = bool(sdc_quiesce)
+        self._sdc_fired: set[tuple[str, int]] = set()
 
     def _consult(self, site: str) -> FaultSpec | None:
         """Advance ``site``'s counter; return the spec to fire, if any."""
@@ -343,6 +407,35 @@ class FaultInjector:
         whose turn it is should be SIGKILLed before the pull (the
         bounded respawn path then runs)."""
         return self._consult("worker_kill") is not None
+
+    def check_sdc(self, run_step: int) -> FaultSpec | None:
+        """Trainer hook, once per optimizer step: the sdc spec to
+        apply at this RUN step, if any. Unlike the occurrence-counted
+        sites, sdc specs fire by step VALUE — a resumed or replayed
+        window covering the step re-fires identically, which is what
+        lets the supervisor's bisection treat replays as ground truth
+        (with ``sdc_quiesce`` disarming the injection there). A
+        ``:hostH`` target fires only when it names this injector's
+        original host; each (site, step) fires at most once per
+        process."""
+        if self.sdc_quiesce:
+            return None
+        with self._lock:
+            for spec in self.specs:
+                if spec.kind not in SDC_SITES:
+                    continue
+                if not spec.at <= run_step < spec.at + spec.times:
+                    continue
+                if spec.host is not None and spec.host != self.host:
+                    continue
+                key = (spec.kind, int(run_step))
+                if key in self._sdc_fired:
+                    continue
+                self._sdc_fired.add(key)
+                spec.fired += 1
+                self.fired.append(key)
+                return spec
+        return None
 
     def corrupt_checkpoint(self, step_dir: str | Path) -> bool:
         """Checkpoint hook, per committed save: garble the largest file

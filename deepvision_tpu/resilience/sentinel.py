@@ -1,0 +1,502 @@
+"""Silent-failure defense: in-graph numeric sentinels, state
+fingerprints, and the SDC corruption model they are drilled against.
+
+Every failure the stack survives today announces itself — the checkify
+NaN tripwire raises, a dead replica stops answering, a preempted host
+gets a SIGTERM. The hazard this module closes is the host that keeps
+heartbeating while computing the *wrong* answer: silent data corruption
+(SDC) from a flaky HBM chip, a mis-executing core, or a poisoned decode
+worker. At the pod scales the ROADMAP targets SDC is a when, not an if
+(the pjit/TPU-pod playbooks in PAPERS.md run fleets where screening for
+"mercurial cores" is routine ops). Three cooperating defenses:
+
+**In-graph sentinels** (:func:`sentinel_step`)
+    Cheap global invariants computed INSIDE the compiled train step —
+    the L2 norm of the parameter update (the donation-safe stand-in
+    for the global gradient norm: for any first-order optimizer the
+    update is a per-leaf-scaled gradient, so a corrupted gradient is a
+    corrupted update norm), the parameter norm, and their ratio — and
+    merged into the step's existing scalar metrics pytree. They ride
+    the Trainer's pending/drain fetch cadence, so they cost a few
+    reductions per step and ZERO extra host syncs (a per-step
+    ``float()`` consumer is exactly the JX109 stall jaxlint JX116
+    exists to flag). An :class:`EwmaDetector` z-scores each series
+    against its own exponentially-weighted history: a numeric blow-up
+    or a large corrupted update trips within one drain cadence —
+    before the corrupted state ever reaches a checkpoint — and the
+    trip feeds the PR 4 ``RecoveryPolicy`` rollback.
+
+**State fingerprints** (:func:`tree_fingerprint`)
+    A seeded random-sign projection of the replicated parameter tree,
+    accumulated in float64 and digested: same state + same seed is
+    bit-equal, a single-ulp perturbation of any leaf flips the digest.
+    Two consumers: the cross-host agreement audit (every K steps each
+    host fingerprints its replica and the cluster compares —
+    replicated state that disagrees across hosts IS an SDC, caught
+    within K steps of the corruption; ``resilience/cluster.py`` holds
+    the file protocol) and the audited checkpoint manifest (the PR 4
+    sidecar gains the save-time state fingerprint, so a verified
+    restore catches corruption that PREDATES serialization — SHA-256
+    alone only proves the bytes on disk match bytes that were already
+    wrong).
+
+**Deterministic SDC injection** (:func:`apply_sdc`)
+    The drill half: ``faults.py``'s ``sdc_grad``/``sdc_param`` sites
+    fire at a deterministic RUN step (epoch-anchored, so replays from
+    any resume point re-fire identically) on one targeted host, and
+    this module applies the corruption — a small scale of one
+    parameter leaf (a wrong gradient update; silent to the z-score at
+    the default magnitude, caught by the agreement audit) or a
+    single-bit mantissa flip (the classic one-ulp SDC only the
+    fingerprint can see). Attribution — WHICH host computed garbage —
+    is the cluster supervisor's replay bisection
+    (``ClusterSupervisor``): deterministic elastic resume re-runs the
+    suspect window on survivor subsets and compares fingerprints
+    against the replayed ground truth.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import struct
+
+import numpy as np
+
+from deepvision_tpu.obs.metrics import default_registry
+from deepvision_tpu.resilience.recovery import NumericDivergence
+
+__all__ = [
+    "AuditDivergence",
+    "EwmaDetector",
+    "SentinelMonitor",
+    "SentinelTrip",
+    "apply_sdc",
+    "sentinel_step",
+    "tree_fingerprint",
+    "fingerprints_agree",
+]
+
+# sentinel scalar names added to the step's metrics pytree; the "sent_"
+# prefix is the naming contract JX116 keys on and the detector watches
+SENTINEL_KEYS = ("sent_update_norm", "sent_param_norm",
+                 "sent_update_ratio")
+# replay attribution is a RATIO test, not a flat tolerance: a replay
+# on a different host count carries collective reduction-order (and
+# bf16 rounding) noise that hits every host's comparison EQUALLY, so
+# the cleanest host's deviation from the replayed truth is the noise
+# floor and direct corruption shows as the host sitting this factor
+# above it (measured on the 2-host lenet drill: clean-host dev ~2e-5,
+# corrupted-host dev ~9e-4 — 40x). Corruptions BELOW the replay noise
+# floor (a lone ulp flip) are still DETECTED by the bit-exact digest
+# audit, but cross-host-count replay cannot attribute them; majority
+# vote (fleets of 3+) can.
+ATTRIBUTION_RATIO = 4.0
+_FP_BUCKETS = 8  # projection components per fingerprint
+
+
+class SentinelTrip(NumericDivergence):
+    """An in-graph sentinel z-scored outside its history: the silent
+    analog of the checkify tripwire. Subclasses
+    :class:`NumericDivergence` so the Trainer's existing rollback loop
+    (restore newest verified checkpoint, skip the batch window)
+    handles it unchanged."""
+
+    def __init__(self, epoch: int, step_in_epoch: int, key: str,
+                 value: float, z: float):
+        self.key = key
+        self.value = float(value)
+        self.z = float(z)
+        super().__init__(epoch, step_in_epoch)
+        # NumericDivergence's message names NaN/Inf; ours names the
+        # sentinel that moved
+        self.args = (
+            f"sentinel {key}={value:.6g} tripped (|z|={z:.1f}) at "
+            f"epoch {epoch} step {step_in_epoch}",)
+
+    def __str__(self) -> str:
+        return self.args[0]
+
+
+class AuditDivergence(RuntimeError):
+    """Cross-host fingerprint disagreement on replicated state — by
+    construction an SDC somewhere in the fleet. Carries the audit step
+    and the per-host fingerprints for the supervisor's attribution."""
+
+    def __init__(self, step: int, fps: dict):
+        self.step = int(step)
+        self.fps = fps
+        super().__init__(
+            f"cross-host state fingerprints disagree at audit step "
+            f"{step}: "
+            + " ".join(f"host{h}={fp['digest']}"
+                       for h, fp in sorted(fps.items())))
+
+
+# --------------------------------------------------------- in-graph step
+
+
+def sentinel_step(step_fn):
+    """Wrap a pure ``step_fn(state, batch, key) -> (state, metrics)``
+    so the compiled step ALSO emits the sentinel scalars.
+
+    The additions are a handful of global reductions over the params
+    (one extra scalar pytree output — no new HBM-resident tensors, no
+    change to the donated state aliasing: the update ``new - old`` is
+    computed from values the optimizer update already has live). The
+    update norm is the donation-safe global-gradient-norm stand-in;
+    the ratio update/param is the classic "learning-rate sanity"
+    invariant (a healthy step moves parameters by a small fraction)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _norm_sq(tree):
+        leaves = [l for l in jax.tree_util.tree_leaves(tree)
+                  if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+        if not leaves:
+            return jnp.float32(0.0)
+        return sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                   for l in leaves)
+
+    def wrapped(state, batch, key):
+        new_state, metrics = step_fn(state, batch, key)
+        delta_sq = _norm_sq(jax.tree.map(
+            lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+            new_state.params, state.params))
+        param_sq = _norm_sq(new_state.params)
+        update_norm = jnp.sqrt(delta_sq)
+        param_norm = jnp.sqrt(param_sq)
+        metrics = dict(metrics)
+        metrics["sent_update_norm"] = update_norm
+        metrics["sent_param_norm"] = param_norm
+        metrics["sent_update_ratio"] = update_norm / (param_norm + 1e-12)
+        return new_state, metrics
+
+    return wrapped
+
+
+# ----------------------------------------------------------- the detector
+
+
+class EwmaDetector:
+    """Per-series EWMA mean/variance z-score anomaly detector.
+
+    Adapts to benign drift (an lr-decay'd loss curve moves the EWMA
+    with it) while a step-function anomaly lands many sigma outside
+    the tracked band. ``warmup`` observations per key must land before
+    any z-test (a cold variance estimate trips on everything);
+    non-finite values trip immediately, warmup included — NaN is never
+    in-band. A relative sigma floor keeps a converged, near-constant
+    series from shrinking its band to machine epsilon and tripping on
+    the next harmless wiggle."""
+
+    def __init__(self, *, z_threshold: float = 8.0, warmup: int = 16,
+                 alpha: float = 0.2, min_rel_sigma: float = 1e-3):
+        if z_threshold <= 0:
+            raise ValueError(f"z_threshold must be > 0, got {z_threshold}")
+        if warmup < 2:
+            raise ValueError(f"warmup must be >= 2, got {warmup}")
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.z_threshold = float(z_threshold)
+        self.warmup = int(warmup)
+        self.alpha = float(alpha)
+        self.min_rel_sigma = float(min_rel_sigma)
+        self._stats: dict[str, list] = {}  # key -> [count, mean, var]
+
+    def reset(self) -> None:
+        """Forget all history — called after a rollback (the restored
+        state jumps every series back; re-warming beats re-tripping)."""
+        self._stats.clear()
+
+    def observe(self, key: str, value: float) -> float | None:
+        """Fold one sample in; returns the |z|-score when it TRIPS
+        (non-finite, or outside the band post-warmup), else None."""
+        value = float(value)
+        if not math.isfinite(value):
+            return float("inf")
+        st = self._stats.get(key)
+        if st is None:
+            st = self._stats[key] = [0, value, 0.0]
+        count, mean, var = st
+        z = None
+        if count >= self.warmup:
+            sigma = math.sqrt(var)
+            floor = self.min_rel_sigma * max(abs(mean), 1e-12)
+            sigma = max(sigma, floor)
+            z = abs(value - mean) / sigma
+        # EWMA update AFTER the test (the anomaly must not shift its
+        # own acceptance band); variance tracks squared deviation from
+        # the pre-update mean (West 1979 incremental form)
+        a = self.alpha if count else 1.0
+        d = value - mean
+        st[0] = count + 1
+        st[1] = mean + a * d
+        st[2] = (1.0 - a) * (var + a * d * d) if count else 0.0
+        if z is not None and z > self.z_threshold:
+            return z
+        return None
+
+
+# ---------------------------------------------------------- fingerprints
+
+
+def _host_local(x) -> np.ndarray:
+    """Host view of (the local replica of) an array. Multi-process
+    replicated jax.Arrays are not fully addressable, but each process's
+    local shard IS the full replica — exactly the per-host value the
+    agreement audit wants to compare."""
+    try:
+        import jax
+
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            x = x.addressable_data(0)
+    except ImportError:  # jax-free consumers (tests over numpy trees)
+        pass
+    return np.asarray(x)
+
+
+def _leaves_with_paths(tree):
+    """(path-string, leaf) pairs in a deterministic order, without
+    requiring jax (plain dict/list trees fingerprint too)."""
+    try:
+        import jax
+
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return [(jax.tree_util.keystr(path), leaf)
+                for path, leaf in flat]
+    except ImportError:
+        out = []
+
+        def walk(node, prefix):
+            if isinstance(node, dict):
+                for k in sorted(node):
+                    walk(node[k], f"{prefix}/{k}")
+            elif isinstance(node, (list, tuple)):
+                for i, v in enumerate(node):
+                    walk(v, f"{prefix}/{i}")
+            else:
+                out.append((prefix, node))
+
+        walk(tree, "")
+        return out
+
+
+def tree_fingerprint(tree, *, seed: int = 0,
+                     signs_cache: dict | None = None) -> dict:
+    """Seeded random-sign projection + energy fingerprint of a pytree.
+
+    Each floating leaf is flattened and (in float64) both dotted
+    against a deterministic ±1 sign vector derived from ``seed`` and
+    the leaf's tree path AND summed-of-squares; leaf values accumulate
+    into ``_FP_BUCKETS`` sign components followed by ``_FP_BUCKETS``
+    energy components, all digested together (SHA-256 over the packed
+    doubles, truncated). The energy half exists because a constant
+    leaf meeting a balanced sign vector projects to ~zero — a uniform
+    scale corruption of it would be invisible to the sign projection
+    alone (found by the tamper test); the sum of squares sees every
+    scale change, the sign projection sees permutations and sign
+    flips that preserve energy. Properties the tests pin:
+
+    - same tree + same seed -> bit-equal digest on every host (the
+      sign vectors depend only on (seed, path, size); float64
+      accumulation in a fixed order is deterministic);
+    - a single-ulp perturbation of ANY leaf element flips the digest
+      (ulp-scale deltas are far above float64 rounding at these
+      magnitudes, and the energy term catches sign-cancelled cases).
+
+    Returns ``{"digest": hex16, "proj": [float64 x 16], "seed": s}``.
+    ``signs_cache`` (keyed by (seed, path, size)) amortizes the sign
+    generation across repeated audits of the same tree shape.
+    """
+    proj = np.zeros(2 * _FP_BUCKETS, np.float64)
+    for i, (path, leaf) in enumerate(_leaves_with_paths(tree)):
+        arr = _host_local(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        flat = arr.astype(np.float64, copy=False).reshape(-1)
+        ck = (seed, path, flat.size)
+        signs = signs_cache.get(ck) if signs_cache is not None else None
+        if signs is None:
+            rng = np.random.default_rng(
+                np.uint64(seed)
+                + np.frombuffer(
+                    hashlib.sha256(path.encode()).digest()[:8],
+                    np.uint64)[0])
+            signs = (rng.integers(0, 2, size=flat.size,
+                                  dtype=np.int8) * 2 - 1
+                     ).astype(np.float64)
+            if signs_cache is not None:
+                signs_cache[ck] = signs
+        proj[i % _FP_BUCKETS] += float(np.dot(flat, signs))
+        proj[_FP_BUCKETS + i % _FP_BUCKETS] += float(np.dot(flat, flat))
+    digest = hashlib.sha256(
+        struct.pack(f"<{len(proj)}d", *proj)).hexdigest()[:16]
+    return {"digest": digest, "proj": [float(p) for p in proj],
+            "seed": int(seed)}
+
+
+def fingerprints_agree(a: dict, b: dict) -> bool:
+    """The bit-exact digest test — peers running the SAME collective
+    layout compute bit-identical replicated state, so any digest
+    difference is an SDC (or a replay on different hardware/topology,
+    which is :func:`fingerprint_deviation`'s territory)."""
+    return a["digest"] == b["digest"]
+
+
+def fingerprint_deviation(a: dict, b: dict) -> float:
+    """Globally-normalized distance between two fingerprints' raw
+    projections: per half (sign projections, then energies — different
+    units), ``max_b |pa - pb| / max(|half|_inf, tiny)``, maxed over
+    the halves. The GLOBAL (per-half) normalization matters —
+    per-bucket relative deviation lets a near-zero bucket's floating
+    noise dominate, hiding a real corruption delta sitting in a large
+    bucket (the failure mode the first cut of replay attribution
+    measured on the lenet drill)."""
+    pa = np.asarray(a["proj"], np.float64)
+    pb = np.asarray(b["proj"], np.float64)
+    half = len(pa) // 2 or 1
+    dev = 0.0
+    for sl in (slice(0, half), slice(half, None)):
+        ha, hb = pa[sl], pb[sl]
+        if ha.size == 0:
+            continue
+        scale = max(float(np.max(np.abs(ha))),
+                    float(np.max(np.abs(hb))), 1e-9)
+        dev = max(dev, float(np.max(np.abs(ha - hb))) / scale)
+    return dev
+
+
+# ------------------------------------------------------------- injection
+
+# sdc_grad: multiply one leaf by (1 + 2^-10) — a wrong-magnitude
+# gradient update, deliberately SILENT to the z-score detector at the
+# default so drills exercise the agreement-audit path; ``:ARG`` (a
+# float) overrides the scale for loud single-host detector drills.
+SDC_GRAD_SCALE = 1.0 + 2.0 ** -10
+
+
+def apply_sdc(state, spec):
+    """Apply one scheduled silent corruption to the LOCAL replica of
+    the first floating parameter leaf (deterministic flatten order):
+
+    - ``sdc_grad``: scale the leaf by ``spec.arg`` (default
+      ``SDC_GRAD_SCALE``) — models a corrupted gradient/update;
+    - ``sdc_param``: XOR the lowest mantissa bit of element 0 — the
+      one-ulp bit-flip only the fingerprint audit can see.
+
+    Only this process's addressable replica is rebuilt
+    (``make_array_from_single_device_arrays``), which is exactly how
+    real SDC manifests: the global array's replicas silently disagree
+    while every collective keeps matching."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state.params)
+    # deterministic target: the LARGEST floating leaf (a kernel, not a
+    # 6-element bias — the corruption must actually flow through the
+    # forward pass for the loud-scale detector drills to mean anything)
+    idx = max(
+        (i for i, l in enumerate(leaves)
+         if np.issubdtype(np.dtype(l.dtype), np.floating)),
+        key=lambda i: int(np.prod(leaves[i].shape)), default=None)
+    if idx is None:
+        return state
+    leaf = leaves[idx]
+
+    def mutate(arr: np.ndarray) -> np.ndarray:
+        arr = np.array(arr)  # copy: never poison a shared buffer
+        if spec.kind == "sdc_grad":
+            scale = spec.arg if spec.arg is not None else SDC_GRAD_SCALE
+            return (arr * arr.dtype.type(scale)).astype(arr.dtype)
+        # sdc_param: single-bit flip (f32 leaves; other dtypes fall
+        # back to the smallest representable scale nudge)
+        if arr.dtype == np.float32:
+            flat = arr.reshape(-1).view(np.uint32)
+            flat[0] ^= np.uint32(1)
+        else:
+            flat = arr.reshape(-1)
+            flat[0] = np.nextafter(flat[0], np.inf, dtype=arr.dtype)
+        return arr
+
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        bufs = [jax.device_put(mutate(np.asarray(s.data)), s.device)
+                for s in leaf.addressable_shards]
+        new_leaf = jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, bufs)
+    else:
+        new_leaf = jax.device_put(mutate(_host_local(leaf)),
+                                  getattr(leaf, "sharding", None))
+    leaves[idx] = new_leaf
+    return state.replace(
+        params=jax.tree_util.tree_unflatten(treedef, leaves))
+
+
+# -------------------------------------------------------------- monitor
+
+
+class SentinelMonitor:
+    """The Trainer's sentinel bundle: the detector over the drained
+    ``loss``/``sent_*`` series, the audit cadence, the fingerprint
+    (with its sign cache), and the obs counters
+    (``sentinel_trips`` / ``sentinel_audits``).
+
+    ``audit_every`` is in RUN steps (epoch * steps_per_epoch +
+    step-in-epoch — the epoch-anchored counter that makes a resumed or
+    replayed window audit at the SAME points as the uninterrupted
+    run). ``replay_until`` puts the Trainer in replay-bisection mode:
+    train deterministically to that run step (auditing on the way),
+    then stop WITHOUT saving — the supervisor reads the audit files as
+    the replay's verdict."""
+
+    WATCH_KEYS = ("loss",) + SENTINEL_KEYS
+
+    def __init__(self, *, z_threshold: float = 8.0, warmup: int = 16,
+                 audit_every: int = 16, fingerprint_seed: int = 0,
+                 replay_until: int | None = None, registry=None):
+        if audit_every < 1:
+            raise ValueError(
+                f"audit_every must be >= 1, got {audit_every}")
+        self.detector = EwmaDetector(z_threshold=z_threshold,
+                                     warmup=warmup)
+        self.audit_every = int(audit_every)
+        self.fingerprint_seed = int(fingerprint_seed)
+        self.replay_until = (int(replay_until)
+                             if replay_until is not None else None)
+        self._signs_cache: dict = {}
+        reg = registry if registry is not None else default_registry()
+        self.trips = reg.counter("sentinel_trips")
+        self.audits = reg.counter("sentinel_audits")
+
+    def observe(self, epoch: int, step_in_epoch: int,
+                metrics: dict) -> None:
+        """Fold one drained step's metrics in; raises
+        :class:`SentinelTrip` on the first watched series that
+        z-scores out of band."""
+        for key in self.WATCH_KEYS:
+            if key not in metrics:
+                continue
+            z = self.detector.observe(key, metrics[key])
+            if z is not None:
+                self.trips.inc()
+                raise SentinelTrip(epoch, step_in_epoch, key,
+                                   metrics[key], z)
+
+    def reset(self) -> None:
+        self.detector.reset()
+
+    def audit_due(self, run_step: int) -> bool:
+        return run_step > 0 and run_step % self.audit_every == 0
+
+    def fingerprint_state(self, state) -> dict:
+        """Fingerprint the replicated model state (params +
+        batch_stats — the tree every data-parallel host must agree on
+        bit-exactly; a ZeRO-1-sharded opt_state is legitimately
+        different per host and is excluded). Used by both the
+        cross-host audit (which counts it via ``audits``) and the
+        checkpoint manifest (which does not — manifests are not
+        agreement checks)."""
+        tree = {"params": state.params}
+        if getattr(state, "batch_stats", None):
+            tree["batch_stats"] = state.batch_stats
+        return tree_fingerprint(tree, seed=self.fingerprint_seed,
+                                signs_cache=self._signs_cache)

@@ -92,13 +92,21 @@ class CheckpointManager:
         self.integrity = integrity
         self._injector = fault_injector
         self._pending_manifests: list[int] = []
+        # save-time state fingerprints awaiting their (possibly
+        # deferred) manifest commit — resilience/sentinel.py's audited
+        # checkpoints; computed at save() entry, so even an async save
+        # records the state the caller actually handed over
+        self._fingerprints: dict[int, dict] = {}
         self._mgr = ocp.CheckpointManager(
             self.directory, options=ocp.CheckpointManagerOptions(**opts)
         )
 
     def save(self, epoch: int, state, *, loggers: Loggers | None = None,
              extra: dict[str, Any] | None = None, best_metric=None,
-             metrics: dict[str, float] | None = None) -> None:
+             metrics: dict[str, float] | None = None,
+             state_fingerprint: dict | None = None) -> None:
+        if state_fingerprint is not None:
+            self._fingerprints[int(epoch)] = dict(state_fingerprint)
         meta = {
             "epoch": int(epoch),
             "loggers": loggers.to_json() if loggers else None,
@@ -169,8 +177,12 @@ class CheckpointManager:
 
     def _write_manifest(self, epoch: int) -> None:
         # atomic + multi-writer-safe (unique tmp name + os.replace):
-        # see train/manifest.write_manifest
-        _manifest.write_manifest(self.directory, epoch)
+        # see train/manifest.write_manifest; the save-time state
+        # fingerprint (if the trainer supplied one) rides along
+        fp = self._fingerprints.pop(int(epoch), None)
+        _manifest.write_manifest(
+            self.directory, epoch,
+            extra={"state_fingerprint": fp} if fp else None)
 
     def verify_epoch(self, epoch: int) -> tuple[bool, str]:
         """-> (ok, reason). An epoch with NO manifest verifies vacuously
@@ -212,19 +224,32 @@ class CheckpointManager:
         return sorted(int(p.name) for p in self.directory.iterdir()
                       if p.is_dir() and p.name.isdigit())
 
-    def restore_verified(self, state, *, counters=None, log=print):
+    def restore_verified(self, state, *, counters=None, log=print,
+                         fingerprint_fn=None):
         """Newest-first verified restore: checksum-verify each epoch,
         quarantine failures (counting ``ckpt_fallbacks``), and return
         the first epoch that both verifies and restores — the
         crash-free ``resume()`` the recovery layer promises. Raises
         ``FileNotFoundError`` only when no epoch survives.
-        """
+
+        ``fingerprint_fn(state) -> {"digest": ...}`` (the sentinel
+        monitor's state fingerprint) arms the AUDITED layer: when the
+        manifest recorded a save-time ``state_fingerprint``, the
+        restored state is re-fingerprinted and a digest mismatch
+        quarantines the epoch exactly like a checksum failure — the
+        case where the bytes round-tripped faithfully but were already
+        corrupt before serialization (SDC between the last audit and
+        the save)."""
         self.wait_until_finished()
         for epoch in reversed(self.fs_epochs()):
             ok, why = self.verify_epoch(epoch)
             if ok:
                 try:
-                    return self.restore(state, epoch)
+                    restored, meta = self.restore(state, epoch)
+                    why = self._check_fingerprint(
+                        epoch, restored, fingerprint_fn)
+                    if why is None:
+                        return restored, meta
                 except Exception as e:
                     if self._manifest_path(epoch).exists():
                         # checksums PROVED the files intact, yet restore
@@ -245,6 +270,24 @@ class CheckpointManager:
         raise FileNotFoundError(
             f"no verifiable checkpoints left in {self.directory} "
             "(corrupt epochs moved to quarantine/)")
+
+    def _check_fingerprint(self, epoch: int, restored,
+                           fingerprint_fn) -> str | None:
+        """None when the audited-fingerprint layer passes (or does not
+        apply); else the quarantine reason."""
+        if fingerprint_fn is None:
+            return None
+        m = _manifest.read_manifest(self.directory, epoch)
+        want = (m or {}).get("state_fingerprint")
+        if not isinstance(want, dict) or "digest" not in want:
+            return None  # pre-audit epoch: hash verification stands
+        got = fingerprint_fn(restored)
+        if got["digest"] == want["digest"]:
+            return None
+        return (f"state fingerprint mismatch (restored "
+                f"{got['digest']} != saved {want['digest']}): the "
+                "bytes round-tripped but the state was corrupt before "
+                "serialization")
 
     @staticmethod
     def _payload(state) -> dict:

@@ -49,11 +49,20 @@ def step_dir(root: str | Path, epoch: int) -> Path:
     return Path(root) / str(epoch)
 
 
-def write_manifest(root: str | Path, epoch: int) -> None:
+def write_manifest(root: str | Path, epoch: int,
+                   extra: dict | None = None) -> None:
     """Hash the committed epoch directory into its sidecar. Atomic and
     multi-writer-safe: the tmp name is unique per (pid, call), so
     concurrent writers each stage complete bytes and the last
-    ``os.replace`` wins with a valid file."""
+    ``os.replace`` wins with a valid file.
+
+    ``extra`` merges additional audited fields into the sidecar —
+    notably ``state_fingerprint`` (resilience/sentinel.py), the
+    save-time random-projection fingerprint of the in-memory state:
+    SHA-256 proves the bytes on disk match the bytes that were
+    written; the fingerprint lets a verified restore prove those bytes
+    match the state the trainer MEANT to save (corruption that
+    predates serialization)."""
     root = Path(root)
     sdir = step_dir(root, epoch)
     if not sdir.exists():  # e.g. keep_best evicted it already
@@ -66,12 +75,21 @@ def write_manifest(root: str | Path, epoch: int) -> None:
         for p in sorted(sdir.rglob("*")) if p.is_file()
     }
     manifest = {"version": MANIFEST_VERSION, "epoch": int(epoch),
-                "files": files}
+                "files": files, **(extra or {})}
     target = manifest_path(root, epoch)
     tmp = target.with_suffix(
         f".json.tmp.{os.getpid()}.{next(_tmp_seq)}")
     tmp.write_text(json.dumps(manifest))
     os.replace(tmp, target)
+
+
+def read_manifest(root: str | Path, epoch: int) -> dict | None:
+    """The committed sidecar as a dict (None when absent/unreadable) —
+    how the verified restore reads the audited ``state_fingerprint``."""
+    try:
+        return json.loads(manifest_path(root, epoch).read_text())
+    except (OSError, ValueError):
+        return None
 
 
 def verify_manifest(root: str | Path, epoch: int) -> tuple[bool, str]:

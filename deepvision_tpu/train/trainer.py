@@ -152,6 +152,7 @@ class Trainer:
         rss_limit_gb: float | None = None,
         recovery=None,
         fault_injector=None,
+        sentinel=None,
         ckpt_integrity: bool = True,
         profile_steps: str | None = None,
         profile_dir: str | Path | None = None,
@@ -194,6 +195,18 @@ class Trainer:
         self.injector = fault_injector
         self.rec_counters = RecoveryCounters()
         self._consecutive_rollbacks = 0
+        # silent-failure defense (resilience/sentinel.py): in-graph
+        # sentinel scalars fused into the compiled step, z-scored on
+        # the existing drain cadence; cross-host state audits every
+        # monitor.audit_every RUN steps (epoch * steps_per_epoch +
+        # step — the epoch-anchored counter that makes resumes and
+        # supervisor replays audit/inject at identical points)
+        self.sentinel = sentinel
+        self.steps_per_epoch = steps_per_epoch
+        if sentinel is not None:
+            from deepvision_tpu.resilience.sentinel import sentinel_step
+
+            train_step = sentinel_step(train_step)
         if recovery is not None:
             if not check_numerics:
                 # rollback needs the tripwire: without checkify the NaN
@@ -296,6 +309,11 @@ class Trainer:
         # exactly as before
         self.cluster = None
         self._cluster_stop: int | None = None
+        # silent-failure exit surface: replay_done set when a
+        # supervisor replay window completes; sdc_detected when a
+        # cross-host audit diverged (train.py exits 76 on it)
+        self.replay_done = False
+        self.sdc_detected = False
         # per-epoch KeySeq derived in train_epoch from this root key
         self._base_key = jax.random.key(seed + 1)
 
@@ -353,6 +371,38 @@ class Trainer:
                     f"reached (dispatched {dispatched})")
             self._cluster_stop = int(mark["stop_step"])
         return False
+
+    def _run_step(self, epoch: int, step_in_epoch: int) -> int:
+        """The epoch-anchored run-step counter (epoch *
+        steps_per_epoch + step): identical for the uninterrupted run,
+        a mid-epoch resume, and a supervisor replay — the determinism
+        the sdc sites and the audit cadence key on. Falls back to the
+        process-local transferred-batch counter when the epoch length
+        is unknown (no drills run that way)."""
+        if self.steps_per_epoch:
+            return epoch * self.steps_per_epoch + step_in_epoch
+        return self._global_step
+
+    def _cluster_audit(self, epoch: int, run_step: int) -> None:
+        """Fingerprint the replicated state and run the lag-tolerant
+        cross-host comparison; a divergence is an SDC somewhere in the
+        fleet — publish the marker and abandon the generation (exit
+        76) so the supervisor can attribute by replay bisection."""
+        fp = self.sentinel.fingerprint_state(self.state)
+        self.sentinel.audits.inc()
+        div = self.cluster.record_audit(run_step, fp)
+        if div is not None:
+            self._raise_divergence(div)
+
+    def _raise_divergence(self, div: dict):
+        from deepvision_tpu.resilience.sentinel import AuditDivergence
+
+        err = AuditDivergence(div["step"], div["fps"])
+        print(f"[sentinel] {err} — abandoning the generation for "
+              "supervisor attribution (replay bisection)", flush=True)
+        self.cluster.write_divergence(div)
+        self.sdc_detected = True
+        raise err
 
     def _cluster_degrade(self, why: str) -> bool:
         print(f"[cluster] host {self.cluster.host}: {why}; exiting "
@@ -544,7 +594,8 @@ class Trainer:
             # is quarantined and the newest verified older epoch wins,
             # instead of an Orbax decode crash killing the relaunch
             self.state, meta = self.ckpt.restore_verified(
-                self.state, counters=self.rec_counters)
+                self.state, counters=self.rec_counters,
+                fingerprint_fn=self._fingerprint_fn())
         else:
             if self.recovery is not None:
                 # operator-pinned epoch: verify it too, but NEVER
@@ -562,6 +613,16 @@ class Trainer:
         self._apply_meta(meta)
         self.start_epoch = meta["epoch"] + 1
         self.start_step = 0
+
+    def _fingerprint_fn(self):
+        """State-fingerprint recompute hook for the verified restore
+        (audited checkpoints): with sentinels on, a restore whose
+        recomputed fingerprint mismatches the manifest's save-time one
+        is corruption that predates serialization and quarantines like
+        any checksum failure."""
+        if self.sentinel is None:
+            return None
+        return self.sentinel.fingerprint_state
 
     def _reshard_state(self) -> None:
         """Re-establish the compiled step's state shardings after a
@@ -673,7 +734,9 @@ class Trainer:
         keys.skip(start_step * self.data_echo)
         t0 = time.perf_counter()
         counts: list[int] = []
-        pending: list[dict] = []  # device scalars not yet fetched
+        # device scalars not yet fetched, as (step_in_epoch, metrics):
+        # the step index is what a sentinel trip hands the rollback
+        pending: list[tuple[int, dict]] = []
         fetched: list[dict] = []  # host floats; each metric fetched ONCE
 
         def drain():
@@ -684,10 +747,16 @@ class Trainer:
             if not pending:
                 return
             with span("drain", cat="train"):
-                for m in pending:
-                    fetched.append({k: float(v) for k, v in m.items()})
+                for step_idx, m in pending:
+                    host = {k: float(v) for k, v in m.items()}
+                    fetched.append(host)
                     if self._watchdog:
                         self._watchdog.beat()
+                    if self.sentinel is not None:
+                        # EWMA z-score over loss + the in-graph sent_*
+                        # scalars; raises SentinelTrip (a
+                        # NumericDivergence) into the rollback loop
+                        self.sentinel.observe(epoch, step_idx, host)
                 pending.clear()
 
         def counted():
@@ -763,7 +832,27 @@ class Trainer:
                                 # batch window)
                                 raise NumericDivergence(
                                     epoch, start_step + i, e) from e
-                            pending.append(metrics)
+                            pending.append((start_step + i, metrics))
+                    run_step = self._run_step(epoch, start_step + i + 1)
+                    if self.injector is not None:
+                        # deterministic SDC drill sites (faults.py
+                        # sdc_grad/sdc_param): keyed by RUN step, so a
+                        # resumed or replayed window re-fires (or, in a
+                        # quiesced replay, re-omits) identically
+                        sdc = self.injector.check_sdc(run_step)
+                        if sdc is not None:
+                            from deepvision_tpu.resilience.sentinel import (
+                                apply_sdc,
+                            )
+
+                            # deliberate one-shot host sync: chaos
+                            # injection fires a bounded handful of
+                            # times per drill, never steady-state
+                            self.state = apply_sdc(  # jaxlint: disable=JX109
+                                self.state, sdc)
+                            print(f"[fault] {sdc.kind} corrupted local "
+                                  f"state at run step {run_step}",
+                                  flush=True)
                     # heartbeats land only in drain() (per COMPLETED
                     # step): a dispatch-side beat marks an ENQUEUED step,
                     # so a wedged device would keep "beating" until the
@@ -788,6 +877,26 @@ class Trainer:
                             drain()
                     elif self._watchdog and i % cad == 0:
                         drain()
+                    if self.sentinel is not None \
+                            and self.cluster is not None \
+                            and self.sentinel.audit_due(run_step):
+                        # cross-host agreement audit: ONE bounded host
+                        # sync every audit_every steps, on the drain
+                        # cadence (a per-step fingerprint is exactly
+                        # the JX109/JX116 stall class)
+                        drain()
+                        self._cluster_audit(epoch, run_step)
+                    if self.sentinel is not None \
+                            and self.sentinel.replay_until is not None \
+                            and run_step >= self.sentinel.replay_until:
+                        # replay-bisection mode: the window is re-run
+                        # and audited; stop WITHOUT saving — the audit
+                        # files are the verdict the supervisor reads
+                        drain()
+                        print(f"[sentinel] replay window complete at "
+                              f"run step {run_step}", flush=True)
+                        self.replay_done = True
+                        return None
                     if (self.rss_limit_bytes
                             and i % (self.log_every or 32) == 0):
                         rss = _process_rss()
@@ -918,9 +1027,14 @@ class Trainer:
             ) from nd
         self._consecutive_rollbacks += 1
         self.rec_counters.inc("rollbacks")
+        if self.sentinel is not None:
+            # the restored state jumps every watched series back;
+            # re-warm the detector instead of re-tripping on the jump
+            self.sentinel.reset()
         try:
             self.state, meta = self.ckpt.restore_verified(
-                self.state, counters=self.rec_counters)
+                self.state, counters=self.rec_counters,
+                fingerprint_fn=self._fingerprint_fn())
             source = f"epoch-{meta['epoch']} checkpoint"
         except FileNotFoundError:
             self.state = jax.device_put(self._init_state)
@@ -937,8 +1051,7 @@ class Trainer:
                 self.plateau.scale = scale  # keep controller consistent
             self.rec_counters.inc("lr_rewarms")
         resume_step = nd.step_in_epoch + pol.skip_batches
-        print(f"[rollback] NaN/Inf at epoch {nd.epoch} step "
-              f"{nd.step_in_epoch}: restored {source}; resuming epoch "
+        print(f"[rollback] {nd}: restored {source}; resuming epoch "
               f"{nd.epoch} at step {resume_step} "
               f"({self._consecutive_rollbacks}/{pol.max_rollbacks} "
               "consecutive)", flush=True)
@@ -961,6 +1074,30 @@ class Trainer:
                 try:
                     tr = self.train_epoch(epoch, start_step=start_step)
                 except NumericDivergence as nd:
+                    from deepvision_tpu.resilience.sentinel import (
+                        SentinelTrip,
+                    )
+
+                    if self.cluster is not None \
+                            and isinstance(nd, SentinelTrip):
+                        # a sentinel trip is HOST-LOCAL (only the
+                        # corrupted replica's metrics moved): a local
+                        # rollback would desync this host's
+                        # collectives from its peers. Publish the
+                        # self-identified trip (attribution needs no
+                        # bisection — the host caught its own state)
+                        # and hand the generation to the supervisor.
+                        # A checkify NaN is NOT diverted: it derives
+                        # from the psum-shared gradients, so every
+                        # host raises at the same step and the PR 4
+                        # rollback below stays collective-consistent.
+                        self.sdc_detected = True
+                        self.cluster.write_trip(
+                            nd.step_in_epoch, nd.key, nd.value, nd.z)
+                        raise
+                    if self.recovery is None:
+                        raise  # sentinel trip without --recover:
+                        # loud fail-fast, exactly the checkify contract
                     # tripwire -> rollback (resilience/): restore the
                     # last-good state and retry the epoch past the
                     # offending batch window; bounded by max_rollbacks
@@ -1032,6 +1169,13 @@ class Trainer:
                     metrics={"plateau_metric": float(
                         metric if metric is not None
                         else self.best_metric)},
+                    # audited checkpoint (resilience/sentinel.py): the
+                    # save-time state fingerprint rides the integrity
+                    # manifest, so a verified restore can catch
+                    # corruption that PREDATES serialization
+                    state_fingerprint=(
+                        self.sentinel.fingerprint_state(self.state)
+                        if self.sentinel is not None else None),
                 )
             # the epoch checkpoint supersedes any earlier preemption save —
             # but only once it is DURABLE: an async save has merely been
@@ -1073,6 +1217,13 @@ class Trainer:
                 print(f"[preempted] after completed epoch {epoch}",
                       flush=True)
                 return self.loggers
+        if self.sentinel is not None and self.cluster is not None:
+            # bounded end-of-run audit sweep: a divergence published at
+            # the final audit step must not slip out with exit 0
+            div = self.cluster.final_audit_check(
+                timeout_s=self.cluster.barrier_timeout_s)
+            if div is not None:
+                self._raise_divergence(div)
         self.ckpt.wait_until_finished()  # commit any in-flight async save
         return self.loggers
 

@@ -220,6 +220,12 @@ _SLOW_TESTS = {
     # fast-tier case — the registry-wide sweep is `make lint-ir`
     "test_ircheck_dcgan_live",
     "test_ircheck_heavy_families_live",
+    # silent-failure defense (ISSUE 12): the real 2-process SDC drill
+    # (audit divergence -> replay bisection -> quarantine -> elastic
+    # completion) — the stub-worker attribution tests cover the logic
+    # in the fast tier, and `make chaos-sdc-smoke` runs the real path
+    # in `make check`
+    "test_two_host_sdc_quarantine_end_to_end",
 }
 # whole modules that spawn real subprocesses (jax.distributed workers)
 _SLOW_MODULES = {"test_distributed"}

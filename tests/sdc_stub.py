@@ -1,0 +1,93 @@
+"""Stub SDC worker: the audit/replay protocol without jax.
+
+Launched by ``tests/test_sentinel.py`` through a ClusterSupervisor with
+an injected ``worker_cmd`` — it heartbeats, publishes state-fingerprint
+audits on a fixed cadence through the REAL ``ClusterMember`` audit
+protocol, and plays the corruption model the bisection is specified
+against, so attribution (majority vote, replay ground truth, sticky
+bisection, the excluded-hosts ledger) is testable in milliseconds per
+step. Not a test module itself.
+
+argv: STEPS STEP_SECONDS
+env (on top of the DVTPU_CLUSTER_* contract train_dist.py exports):
+
+``STUB_SDC_HOST``    original host id that computes garbage
+``STUB_SDC_HOST2``   optional second culprit (multi-fault drills)
+``STUB_SDC_STEP``    run step from which the bad host's fingerprints
+                     diverge
+``STUB_AUDIT_EVERY`` audit cadence in steps (default 4)
+``STUB_SDC_STICKY``  "1": the fault reproduces in replay generations
+                     too (a mercurial core), ignoring the quiesce —
+                     the bisection's dirty-probe path
+``STUB_REPLAY_CRASH`` "1": replay workers die before any audit — the
+                     no-verdict path (attribution must refuse)
+``DVTPU_SENTINEL_REPLAY`` / ``DVTPU_SDC_QUIESCE``
+                     the supervisor's replay contract (cluster.py)
+
+A clean host's fingerprint at audit step S is the deterministic
+``truth-S``; the bad host publishes ``bad-<orig>-S`` from
+``STUB_SDC_STEP`` on. Exit codes: 0 done / replay-complete, 76 SDC
+detected (divergence marker written) — the launcher contract.
+"""
+
+import os
+import sys
+import time
+
+from deepvision_tpu.resilience.cluster import ClusterMember
+
+
+def _fp(step: int, *, bad_as: int | None = None) -> dict:
+    if bad_as is None:
+        return {"digest": f"truth-{step}",
+                "proj": [float(step)] * 8, "seed": 0}
+    return {"digest": f"bad-{bad_as}-{step}",
+            "proj": [float(step + 1000 + bad_as)] * 8, "seed": 0}
+
+
+def main() -> int:
+    steps = int(sys.argv[1])
+    step_s = float(sys.argv[2])
+    member = ClusterMember.from_env()
+    assert member is not None, "stub needs the DVTPU_CLUSTER_* env"
+    orig = int(os.environ.get("DVTPU_CLUSTER_ORIG_HOST", member.host))
+    bad_hosts = {int(os.environ[k]) for k in
+                 ("STUB_SDC_HOST", "STUB_SDC_HOST2")
+                 if os.environ.get(k)}
+    sdc_step = int(os.environ.get("STUB_SDC_STEP", "0"))
+    audit_every = int(os.environ.get("STUB_AUDIT_EVERY", "4"))
+    sticky = os.environ.get("STUB_SDC_STICKY") == "1"
+    quiesce = bool(os.environ.get("DVTPU_SDC_QUIESCE"))
+    replay_raw = os.environ.get("DVTPU_SENTINEL_REPLAY")
+    replay_until = int(replay_raw) if replay_raw else None
+    if replay_until is not None \
+            and os.environ.get("STUB_REPLAY_CRASH") == "1":
+        return 1  # no-verdict replay: dies before any audit lands
+    # the corruption model: the bad host's state is wrong from
+    # sdc_step on; a quiesced replay re-runs on healthy hardware
+    # UNLESS the fault is sticky (lives in the host, not the run)
+    corrupt = orig in bad_hosts and sdc_step and (
+        not quiesce or sticky)
+
+    for cur in range(1, steps + 1):
+        member.beat(cur, epoch=0, status="run", force=True)
+        if cur % audit_every == 0:
+            fp = _fp(cur, bad_as=orig
+                     if corrupt and cur >= sdc_step else None)
+            div = member.record_audit(cur, fp)
+            if div is not None:
+                member.write_divergence(div)
+                return 76
+        if replay_until is not None and cur >= replay_until:
+            return 0
+        time.sleep(step_s)
+    div = member.final_audit_check(timeout_s=5.0)
+    if div is not None:
+        member.write_divergence(div)
+        return 76
+    member.beat(steps, epoch=0, status="done", force=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

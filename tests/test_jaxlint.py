@@ -1691,3 +1691,107 @@ def test_load_config_reads_cluster_funcs(tmp_path):
     # defaults cover the jax join + the repo's own barrier rendezvous
     assert "*distributed.initialize" in LintConfig().cluster_funcs
     assert "*await_all_arrived*" in LintConfig().cluster_funcs
+
+
+# ----------------------------------------------------------- JX116
+
+
+def test_jx116_flags_per_step_sentinel_fetch(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        import numpy as np
+        import jax
+
+        def train_epoch(feed, state, train_step, keys):
+            norms = []
+            for i, batch in enumerate(feed):
+                state, m = train_step(state, batch, next(keys))
+                norms.append(float(m["sent_update_norm"]))  # per-step
+                jax.device_get(m["sent_param_norm"])        # per-step
+            return norms
+        """)
+    assert codes(r) == ["JX116", "JX116"]
+    assert "drain" in r.findings[0].message
+    assert "JX109" in r.findings[0].message
+
+
+def test_jx116_passes_drain_cadence_and_non_sentinel(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        def train_epoch(feed, state, train_step, keys):
+            pending = []
+            for i, batch in enumerate(feed):
+                state, m = train_step(state, batch, next(keys))
+                pending.append(m)
+                if i % 16 == 0:
+                    # the sanctioned pattern: fetch on the drain cadence
+                    vals = [float(x["sent_update_norm"])
+                            for x in pending]
+                    pending.clear()
+            # after the loop: always fine
+            tail = [float(x["sent_update_norm"]) for x in pending]
+            return tail
+
+        def other_epoch(feed, state, train_step, keys):
+            losses = []
+            for i, batch in enumerate(feed):
+                state, m = train_step(state, batch, next(keys))
+                losses.append(m)      # no fetch at all
+            return losses
+
+        def summarize(metrics):
+            # matched name pattern but NO step call in the loop
+            out = []
+            for m in metrics:
+                out.append(float(m["sent_update_norm"]))
+            return out
+
+        def multi_epoch_fit(feed, state, train_step, keys):
+            # per-EPOCH fetch after an inner step loop: the nested
+            # loop is the per-step scope, the outer fetch is the
+            # sanctioned batch point
+            for ep in range(3):
+                for i, batch in enumerate(feed):
+                    state, m = train_step(state, batch, next(keys))
+                tail = float(m["sent_update_norm"])
+            return state
+
+        def sentiment_epoch(feed, state, train_step, docs):
+            # 'sent'-prefixed-but-unrelated names are NOT sentinel
+            # outputs (the contract is the sent_* prefix)
+            for i, batch in enumerate(feed):
+                state, m = train_step(state, batch, docs)
+                score = float(batch["sentiment"])
+                n = int(m["sentence_count"])
+            return state
+        """)
+    assert codes(r) == []
+
+
+def test_jx116_sentinel_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(sentinel_funcs=["consume_*"])
+    r = lint(tmp_path, "lib/loop.py", """
+        def consume_metrics(feed, state, train_step, keys):
+            for i, batch in enumerate(feed):
+                state, m = train_step(state, batch, next(keys))
+                v = float(m["sent_update_norm"])   # matched by knob
+
+        def train_epoch(feed, state, train_step, keys):
+            for i, batch in enumerate(feed):
+                state, m = train_step(state, batch, next(keys))
+                v = float(m["sent_update_norm"])   # NOT matched now
+        """, cfg=cfg)
+    assert codes(r) == ["JX116"]
+
+
+def test_load_config_reads_sentinel_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        sentinel_funcs = ["consume_*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.sentinel_funcs == ["consume_*"]
+    # defaults cover the Trainer's epoch loop naming
+    assert "*epoch*" in LintConfig().sentinel_funcs
+    assert "*fit*" in LintConfig().sentinel_funcs

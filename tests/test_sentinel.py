@@ -1,0 +1,643 @@
+"""Silent-failure defense (resilience/sentinel.py + the cluster
+audit/quarantine protocol): EWMA detector units, fingerprint
+sensitivity pins, the in-graph sentinel step wrapper, the sdc fault
+sites, audited checkpoint manifests (save-time state fingerprint +
+tampered-state detection), the supervisor's replay bisection over
+no-jax stub workers, the --metrics-port exposition surface, and the
+real 2-process chaos twin (slow tier)."""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, replace as _dc_replace
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deepvision_tpu.obs.metrics import Registry, start_exposition_server
+from deepvision_tpu.resilience.cluster import ClusterMember, ClusterSupervisor
+from deepvision_tpu.resilience.faults import (
+    FaultInjector,
+    format_spec,
+    parse_schedule,
+)
+from deepvision_tpu.resilience.sentinel import (
+    ATTRIBUTION_RATIO,
+    EwmaDetector,
+    SentinelMonitor,
+    SentinelTrip,
+    fingerprint_deviation,
+    fingerprints_agree,
+    sentinel_step,
+    tree_fingerprint,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+STUB = Path(__file__).parent / "sdc_stub.py"
+
+
+# ------------------------------------------------------ EWMA detector
+
+
+def test_detector_no_trip_during_warmup():
+    d = EwmaDetector(z_threshold=4.0, warmup=8)
+    # wildly varying warmup samples must not trip (cold variance)
+    for v in (1.0, 9.0, 2.0, 14.0, 0.5, 7.0, 3.0):
+        assert d.observe("loss", v) is None
+
+
+def test_detector_trips_on_spike_after_warmup():
+    d = EwmaDetector(z_threshold=6.0, warmup=8)
+    rng = np.random.default_rng(0)
+    for i in range(40):
+        assert d.observe("loss", 2.0 + 0.01 * rng.standard_normal()) \
+            is None
+    z = d.observe("loss", 40.0)
+    assert z is not None and z > 6.0
+
+
+def test_detector_trips_on_nonfinite_even_in_warmup():
+    d = EwmaDetector(z_threshold=6.0, warmup=16)
+    assert d.observe("loss", 1.0) is None
+    assert d.observe("loss", float("nan")) == math.inf
+    assert d.observe("loss", float("inf")) == math.inf
+
+
+def test_detector_benign_lr_decay_drift_never_trips():
+    """An lr-decayed loss curve drifts steadily downward for hundreds
+    of steps; the EWMA band must follow it (the false-positive guard
+    of the acceptance criteria)."""
+    d = EwmaDetector(z_threshold=8.0, warmup=16)
+    rng = np.random.default_rng(1)
+    v = 4.0
+    for i in range(500):
+        v *= 0.995  # smooth decay
+        noisy = v * (1.0 + 0.02 * rng.standard_normal())
+        assert d.observe("loss", noisy) is None, f"tripped at step {i}"
+
+
+def test_detector_reset_rewarns():
+    d = EwmaDetector(z_threshold=6.0, warmup=4)
+    for _ in range(10):
+        d.observe("loss", 1.0)
+    d.reset()
+    # post-reset the (huge) jump is inside a fresh warmup: no trip
+    assert d.observe("loss", 500.0) is None
+
+
+def test_detector_validates_params():
+    with pytest.raises(ValueError):
+        EwmaDetector(z_threshold=0.0)
+    with pytest.raises(ValueError):
+        EwmaDetector(warmup=1)
+    with pytest.raises(ValueError):
+        EwmaDetector(alpha=0.0)
+
+
+def test_monitor_observe_raises_sentinel_trip():
+    reg = Registry()
+    mon = SentinelMonitor(z_threshold=6.0, warmup=4, registry=reg)
+    for s in range(20):
+        mon.observe(0, s, {"loss": 1.0, "sent_update_norm": 0.1})
+    with pytest.raises(SentinelTrip) as e:
+        mon.observe(1, 3, {"loss": 1.0, "sent_update_norm": 9999.0})
+    assert e.value.key == "sent_update_norm"
+    assert (e.value.epoch, e.value.step_in_epoch) == (1, 3)
+    assert reg.value_of("sentinel_trips") == 1
+    # a SentinelTrip IS a NumericDivergence: the Trainer rollback path
+    from deepvision_tpu.resilience.recovery import NumericDivergence
+
+    assert isinstance(e.value, NumericDivergence)
+
+
+# ------------------------------------------------------- fingerprints
+
+
+def _tree():
+    return {
+        "conv": {"kernel": np.linspace(-1, 1, 64,
+                                       dtype=np.float32).reshape(8, 8),
+                 "bias": np.ones(8, np.float32)},
+        "step": np.int32(7),  # non-float leaf: ignored
+    }
+
+
+def test_fingerprint_same_seed_bit_equal():
+    a, b = tree_fingerprint(_tree()), tree_fingerprint(_tree())
+    assert a["digest"] == b["digest"]
+    assert a["proj"] == b["proj"]
+    assert fingerprints_agree(a, b)
+
+
+def test_fingerprint_single_ulp_flip_changes_digest():
+    t = _tree()
+    base = tree_fingerprint(t)
+    flat = t["conv"]["kernel"].reshape(-1)
+    flat[11] = np.nextafter(flat[11], np.float32(np.inf))  # one ulp
+    tampered = tree_fingerprint(t)
+    assert tampered["digest"] != base["digest"]
+    assert not fingerprints_agree(base, tampered)
+
+
+def test_fingerprint_seed_changes_digest():
+    assert tree_fingerprint(_tree(), seed=0)["digest"] != \
+        tree_fingerprint(_tree(), seed=1)["digest"]
+
+
+def test_fingerprint_signs_cache_reused_and_bit_equal():
+    cache: dict = {}
+    a = tree_fingerprint(_tree(), signs_cache=cache)
+    assert cache  # populated
+    b = tree_fingerprint(_tree(), signs_cache=cache)
+    assert a == b
+
+
+def test_fingerprint_deviation_global_normalization():
+    """The attribution metric normalizes by the GLOBAL projection
+    scale: jitter in a near-zero bucket must not outrank a real delta
+    in a large bucket (the first-cut failure measured on the lenet
+    drill)."""
+    a = {"digest": "x", "proj": [1e-6, 100.0, 0, 0, 0, 0, 0, 0]}
+    noise = {"digest": "y", "proj": [2e-6, 100.0, 0, 0, 0, 0, 0, 0]}
+    corrupt = {"digest": "z", "proj": [1e-6, 100.5, 0, 0, 0, 0, 0, 0]}
+    # per-bucket relative dev would score `noise` (2x on bucket 0) far
+    # above `corrupt` (0.5% on bucket 1); the global metric must not
+    assert fingerprint_deviation(a, noise) < 1e-7
+    assert fingerprint_deviation(a, corrupt) > 1e-3
+    assert fingerprint_deviation(a, corrupt) > \
+        ATTRIBUTION_RATIO * fingerprint_deviation(a, noise)
+
+
+# ------------------------------------------- in-graph sentinel wrapper
+
+
+@dataclass
+class _TinyState:
+    params: dict
+    batch_stats: dict | None = None
+
+    def replace(self, **kw):
+        return _dc_replace(self, **kw)
+
+
+def test_sentinel_step_emits_invariants():
+    import jax.numpy as jnp
+
+    def step(state, batch, key):
+        new = state.replace(params={
+            k: v - 0.5 for k, v in state.params.items()})
+        return new, {"loss": jnp.float32(2.0)}
+
+    state = _TinyState(params={"w": jnp.ones((3, 4)),
+                               "b": jnp.zeros(4)})
+    wrapped = sentinel_step(step)
+    new, m = wrapped(state, {}, None)
+    assert set(m) == {"loss", "sent_update_norm", "sent_param_norm",
+                      "sent_update_ratio"}
+    # update = -0.5 everywhere over 16 elements
+    np.testing.assert_allclose(float(m["sent_update_norm"]),
+                               0.5 * np.sqrt(16), rtol=1e-6)
+    expect_param = np.sqrt(np.sum(np.square(
+        np.asarray(new.params["w"]))) + np.sum(np.square(
+            np.asarray(new.params["b"]))))
+    np.testing.assert_allclose(float(m["sent_param_norm"]),
+                               expect_param, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(m["sent_update_ratio"]),
+        float(m["sent_update_norm"]) / (expect_param + 1e-12),
+        rtol=1e-6)
+
+
+# --------------------------------------------------- sdc fault sites
+
+
+def test_sdc_grammar_host_targeting_roundtrip():
+    specs = parse_schedule("sdc_grad@20:host1,sdcp@5,sdc@3:64")
+    assert [(s.kind, s.at, s.arg, s.host) for s in specs] == [
+        ("sdc_grad", 20, None, 1), ("sdc_param", 5, None, None),
+        ("sdc_grad", 3, 64.0, None)]
+    again = parse_schedule(",".join(format_spec(s) for s in specs))
+    assert [(s.kind, s.at, s.arg, s.host) for s in again] == \
+        [(s.kind, s.at, s.arg, s.host) for s in specs]
+
+
+def test_sdc_grammar_rejects_prob_and_misplaced_host():
+    with pytest.raises(ValueError):
+        parse_schedule("sdc_grad~0.5")  # not replay-deterministic
+    with pytest.raises(ValueError):
+        parse_schedule("nan@3:host1")  # host targets sdc sites only
+
+
+def test_sdc_consult_is_step_keyed_and_host_targeted():
+    inj = FaultInjector("sdc_grad@20:host1", host=1)
+    assert inj.check_sdc(19) is None
+    spec = inj.check_sdc(20)
+    assert spec is not None and spec.kind == "sdc_grad"
+    assert inj.check_sdc(20) is None  # once per (site, step)
+    assert inj.fired == [("sdc_grad", 20)]
+    # the wrong host never fires; a replayed window on the right host
+    # re-fires at the same step (fresh process = fresh injector)
+    assert FaultInjector("sdc_grad@20:host1", host=0) \
+        .check_sdc(20) is None
+    assert FaultInjector("sdc_grad@20:host1", host=1) \
+        .check_sdc(20) is not None
+    # quiesced replay generations are ground truth: nothing fires
+    assert FaultInjector("sdc_grad@20:host1", host=1,
+                         sdc_quiesce=True).check_sdc(20) is None
+
+
+def test_apply_sdc_targets_largest_leaf():
+    import jax.numpy as jnp
+
+    from deepvision_tpu.resilience.sentinel import apply_sdc
+
+    state = _TinyState(params={"big": jnp.ones((16, 16)),
+                               "tiny": jnp.ones(4)})
+    spec = parse_schedule("sdc_grad@0:64")[0]
+    out = apply_sdc(state, spec)
+    np.testing.assert_allclose(np.asarray(out.params["big"]), 64.0)
+    np.testing.assert_allclose(np.asarray(out.params["tiny"]), 1.0)
+
+
+def test_apply_sdc_param_is_a_single_ulp_bit_flip():
+    import jax.numpy as jnp
+
+    from deepvision_tpu.resilience.sentinel import apply_sdc
+
+    state = _TinyState(params={"w": jnp.full((8, 8), 1.5, jnp.float32)})
+    before = tree_fingerprint({"params": state.params})
+    out = apply_sdc(state, parse_schedule("sdc_param@0")[0])
+    a = np.asarray(state.params["w"]).reshape(-1)
+    b = np.asarray(out.params["w"]).reshape(-1)
+    changed = np.nonzero(a != b)[0]
+    assert list(changed) == [0]  # exactly one element
+    assert b[0] == np.nextafter(np.float32(1.5), np.float32(2.0)) \
+        or b[0] == np.nextafter(np.float32(1.5), np.float32(0.0))
+    # ... and the fingerprint audit sees it
+    after = tree_fingerprint({"params": out.params})
+    assert after["digest"] != before["digest"]
+
+
+# --------------------------------------------- audited checkpoints
+
+
+class _CkptState:
+    def __init__(self, scale=1.0):
+        self.params = {"w": np.full((16,), scale, np.float32)}
+        self.batch_stats = {}
+        self.opt_state = {"m": np.zeros((16,), np.float32)}
+        self.step = 0
+        self.extra_vars = None
+
+    def replace(self, **kw):
+        out = _CkptState()
+        out.__dict__.update(self.__dict__)
+        out.__dict__.update(kw)
+        return out
+
+
+def _state_fp(state):
+    tree = {"params": state.params}
+    if getattr(state, "batch_stats", None):
+        tree["batch_stats"] = state.batch_stats
+    return tree_fingerprint(tree)
+
+
+def test_manifest_fingerprint_roundtrip_and_tamper_detection(tmp_path):
+    """The audited-checkpoint contract end to end: the save-time state
+    fingerprint rides the integrity manifest, a faithful round-trip
+    restores through it, and a save whose recorded fingerprint does
+    not match the serialized state (= the state was corrupt before
+    serialization) is quarantined with fallback to the older epoch."""
+    from deepvision_tpu.train import manifest
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.resilience.recovery import RecoveryCounters
+
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    good = _CkptState(scale=1.0)
+    mgr.save(0, good, state_fingerprint=_state_fp(good))
+    m = manifest.read_manifest(mgr.directory, 0)
+    assert m["state_fingerprint"]["digest"] == _state_fp(good)["digest"]
+    # faithful round-trip verifies
+    restored, meta = mgr.restore_verified(
+        _CkptState(), fingerprint_fn=_state_fp)
+    assert meta["epoch"] == 0
+
+    # epoch 1: the state was ALREADY corrupt when serialized — the
+    # manifest carries the fingerprint of what the trainer MEANT to
+    # save, the bytes hold something else; SHA-256 alone passes it
+    corrupt = _CkptState(scale=2.0)
+    meant = _state_fp(_CkptState(scale=1.0))
+    mgr.save(1, corrupt, state_fingerprint=meant)
+    ok, why = mgr.verify_epoch(1)
+    assert ok  # hashes match the (wrong) bytes: SHA cannot see it
+    counters = RecoveryCounters(Registry())
+    logs: list[str] = []
+    restored, meta = mgr.restore_verified(
+        _CkptState(), fingerprint_fn=_state_fp, counters=counters,
+        log=lambda *a, **k: logs.append(a[0]))
+    assert meta["epoch"] == 0  # fell back past the tampered epoch
+    assert counters.get("ckpt_fallbacks") == 1
+    assert any("fingerprint mismatch" in line for line in logs)
+    assert (mgr.directory / "quarantine" / "1").exists()
+    # without the fingerprint hook the tampered epoch restores happily
+    # (exactly why SHA-256 alone was not enough)
+    mgr2 = CheckpointManager(tmp_path / "ckpt")
+    _, meta2 = mgr2.restore_verified(_CkptState())
+    assert meta2["epoch"] == 0  # epoch 1 already quarantined above
+    mgr.close()
+    mgr2.close()
+
+
+# ------------------------------------------- member audit protocol
+
+
+def test_record_audit_lag_tolerant_compare(tmp_path):
+    m0 = ClusterMember(tmp_path, 0, 2)
+    m1 = ClusterMember(tmp_path, 1, 2)
+    fp = {"digest": "aaaa", "proj": [1.0] * 8, "seed": 0}
+    bad = {"digest": "bbbb", "proj": [2.0] * 8, "seed": 0}
+    # host 0 audits steps 8 and 16 before host 1 lands anything
+    assert m0.record_audit(8, fp) is None
+    assert m0.record_audit(16, fp) is None
+    # host 1 catches up: agreement at 8, divergence detected at 16
+    assert m1.record_audit(8, fp) is None
+    div = m1.record_audit(16, bad)
+    assert div is not None and div["step"] == 16
+    assert div["fps"][0]["digest"] == "aaaa"
+    assert div["fps"][1]["digest"] == "bbbb"
+    # host 0's banked audits compare as the peer files land
+    div0 = m0.final_audit_check(timeout_s=1.0)
+    assert div0 is not None and div0["step"] == 16
+
+
+def test_final_audit_check_degrades_on_missing_peer(tmp_path):
+    m0 = ClusterMember(tmp_path, 0, 2)
+    fp = {"digest": "aaaa", "proj": [1.0] * 8, "seed": 0}
+    m0.record_audit(8, fp)
+    t0 = time.monotonic()
+    assert m0.final_audit_check(timeout_s=0.3) is None
+    assert time.monotonic() - t0 < 2.0
+
+
+# ------------------------- supervisor attribution over stub workers
+
+
+def _run_sdc_supervisor(tmp_path, *, num_hosts=2, steps=30,
+                        step_s=0.02, env=None, **kw):
+    logs: list[str] = []
+
+    def log(msg, **_):
+        logs.append(str(msg))
+
+    def worker_cmd(ctx):
+        return [sys.executable, str(STUB), str(steps), str(step_s)]
+
+    reg = Registry()
+    base_env = {
+        "PYTHONPATH": os.pathsep.join(
+            [str(REPO), os.environ.get("PYTHONPATH", "")]
+        ).rstrip(os.pathsep),
+    }
+    base_env.update(env or {})
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("straggler_after_s", 5.0)
+    kw.setdefault("heartbeat_timeout_s", 30.0)
+    kw.setdefault("replay_timeout_s", 30.0)
+    sup = ClusterSupervisor(
+        [], num_hosts, tmp_path, worker_cmd=worker_cmd, env=base_env,
+        registry=reg, log=log, **kw)
+    rc = sup.run()
+    return rc, logs, reg, sup
+
+
+def _ledger_hosts(tmp_path) -> list[int]:
+    ledger = json.loads((tmp_path / "excluded_hosts.json").read_text())
+    return sorted(e["host"] for e in ledger["excluded"])
+
+
+def test_sdc_majority_vote_quarantines_minority_without_replay(
+        tmp_path):
+    """3 hosts, host 2 computes garbage from step 12: the strict
+    fingerprint majority attributes it at the divergent audit with
+    ZERO replays; the job relaunches on the clean pair and completes."""
+    rc, logs, reg, sup = _run_sdc_supervisor(
+        tmp_path, num_hosts=3,
+        env={"STUB_SDC_HOST": "2", "STUB_SDC_STEP": "12"})
+    assert rc == 0, logs[-10:]
+    assert reg.value_of("sentinel_divergences") >= 1
+    assert reg.value_of("sentinel_quarantined") == 1
+    assert sup._replay_n == 0  # majority vote needed no replay
+    assert _ledger_hosts(tmp_path) == [2]
+    assert any("QUARANTINED host 2" in line
+               and "minority" in line for line in logs)
+    assert any("gen 1: launching hosts [0, 1]" in line
+               for line in logs)
+    assert any(line.startswith("[sentinel] trips=0 audits=")
+               for line in logs)
+
+
+def test_sdc_two_host_replay_bisection_finds_culprit(tmp_path):
+    """2 hosts — no majority possible: ONE replay of the clean host
+    (= ceil(log2 2)) re-derives the ground-truth fingerprint and the
+    corrupt host is attributed against it; ledger persisted; the job
+    completes on the survivor."""
+    rc, logs, reg, sup = _run_sdc_supervisor(
+        tmp_path, num_hosts=2,
+        env={"STUB_SDC_HOST": "1", "STUB_SDC_STEP": "12"})
+    assert rc == 0, logs[-10:]
+    assert sup._replay_n == 1  # exactly ceil(log2(2))
+    assert reg.value_of("sentinel_quarantined") == 1
+    assert _ledger_hosts(tmp_path) == [1]
+    assert any("replayed ground truth" in line for line in logs)
+    assert any("gen 1: launching hosts [0]" in line for line in logs)
+
+
+def test_sdc_sticky_multi_fault_bisection_cascade(tmp_path):
+    """Two sticky culprits (hosts 0 and 1 of 4) — no strict majority,
+    and the fault reproduces inside replays: the dirty-probe chain
+    halves the suspects within the ceil(log2 N) budget (the singleton
+    probe rides with an exonerated host so the sticky fault shows as
+    INTERNAL divergence instead of masquerading as ground truth), the
+    first culprit is quarantined by elimination, and the SECOND
+    divergent generation catches the other by majority vote."""
+    rc, logs, reg, sup = _run_sdc_supervisor(
+        tmp_path, num_hosts=4, steps=40,
+        env={"STUB_SDC_HOST": "0", "STUB_SDC_STEP": "12",
+             "STUB_SDC_STICKY": "1", "STUB_SDC_HOST2": "1"})
+    assert rc == 0, logs[-15:]
+    assert _ledger_hosts(tmp_path) == [0, 1]
+    assert reg.value_of("sentinel_quarantined") == 2
+    assert sup._replay_n <= 2  # ceil(log2 4) for the bisected culprit
+    assert any("launching hosts [2, 3]" in line for line in logs)
+
+
+def test_quarantine_sdc_self_identified_trip_needs_no_replay(tmp_path):
+    """A host whose OWN z-score caught its corrupted state is its own
+    attribution: the trip marker convicts it directly (ladder rung 1),
+    zero replays, ledger persisted."""
+    sup = ClusterSupervisor([], 2, tmp_path, registry=Registry(),
+                            log=lambda *a, **k: None)
+    gen = tmp_path / "cluster" / "gen-000"
+    gen.mkdir(parents=True)
+    (gen / "sdc-trip-1.json").write_text(json.dumps(
+        {"host": 1, "step": 21, "key": "sent_update_norm",
+         "value": 1e9, "z": 99.0}))
+    assert sup._quarantine_sdc(gen, [0, 1]) == [1]
+    assert sup._replay_n == 0
+    assert _ledger_hosts(tmp_path) == [1]
+    ledger = json.loads((tmp_path / "excluded_hosts.json").read_text())
+    assert "self-identified" in ledger["excluded"][0]["reason"]
+
+
+def test_sdc_unattributed_refuses_to_continue(tmp_path):
+    """A replay that produces no verdict (workers crash before any
+    audit) must NOT quarantine anyone — the supervisor stops loudly
+    instead of guessing."""
+    rc, logs, reg, sup = _run_sdc_supervisor(
+        tmp_path, num_hosts=2,
+        env={"STUB_SDC_HOST": "1", "STUB_SDC_STEP": "12",
+             "STUB_REPLAY_CRASH": "1"})
+    assert rc == 1
+    assert reg.value_of("sentinel_quarantined") == 0
+    assert not (tmp_path / "excluded_hosts.json").exists()
+    assert any("refusing to continue" in line for line in logs)
+
+
+# ------------------------------------------------ metrics exposition
+
+
+def test_metrics_exposition_server_serves_sentinel_gauges():
+    reg = Registry()
+    reg.counter("sentinel_trips").inc(3)
+    reg.gauge("cluster_host_alive").set(2.0)
+    server, port = start_exposition_server(0, reg, host="127.0.0.1")
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "sentinel_trips_total 3" in body
+        assert "cluster_host_alive 2" in body
+        assert urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).status == 200
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5)
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------- trainer-level integration
+
+
+def _lenet_trainer(tmp_path, *, sentinel, injector=None, recovery=None,
+                   registry=None):
+    from deepvision_tpu.core import create_mesh
+    from deepvision_tpu.data.mnist import batches
+    from deepvision_tpu.data.synthetic import synthetic_classification
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.configs import get_config
+    from deepvision_tpu.train.trainer import Trainer
+
+    cfg = get_config("lenet5")
+    cfg["batch_size"] = 64
+    model = get_model("lenet5", num_classes=cfg["num_classes"])
+    imgs, labels, split = synthetic_classification(
+        512, cfg["input_size"], cfg["channels"], cfg["num_classes"], 64)
+    train_data = lambda e: batches(  # noqa: E731
+        imgs[split:], labels[split:], 64,
+        rng=np.random.default_rng(e))
+    val_data = lambda: batches(imgs[:split], labels[:split], 64,  # noqa: E731
+                               drop_remainder=False)
+    steps = (512 - split) // 64
+    return Trainer(
+        model, cfg, create_mesh(), train_data, val_data,
+        workdir=tmp_path, steps_per_epoch=steps, sentinel=sentinel,
+        fault_injector=injector, recovery=recovery, log_every=0), steps
+
+
+def test_trainer_sentinel_trip_rolls_back_and_faultfree_is_quiet(
+        tmp_path):
+    """The acceptance pair on one config: a loud injected sdc_grad
+    trips the in-graph sentinel within a drain and the PR 4 rollback
+    recovers the run; the fault-free twin with identical sentinel
+    settings trips ZERO times (false-positive guard)."""
+    from deepvision_tpu.resilience import RecoveryPolicy
+
+    reg = Registry()
+    mon = SentinelMonitor(z_threshold=8.0, warmup=8, registry=reg)
+    # 512 images, split 64 -> 7 steps/epoch; run step 9 = epoch 1
+    # step 2, one epoch-0 checkpoint behind the rollback
+    trainer, steps = _lenet_trainer(
+        tmp_path / "drill", sentinel=mon,
+        injector=FaultInjector("sdc_grad@9:64"),
+        recovery=RecoveryPolicy())
+    assert steps == 7
+    trainer.fit(2)
+    assert reg.value_of("sentinel_trips") >= 1
+    assert trainer.rec_counters.get("rollbacks") >= 1
+
+    reg2 = Registry()
+    mon2 = SentinelMonitor(z_threshold=8.0, warmup=8, registry=reg2)
+    twin, _ = _lenet_trainer(tmp_path / "twin", sentinel=mon2)
+    twin.fit(2)
+    assert reg2.value_of("sentinel_trips") == 0
+    # audited checkpoint: the manifest carries the state fingerprint
+    from deepvision_tpu.train import manifest
+
+    m = manifest.read_manifest(twin.ckpt.directory, 1)
+    assert m and m.get("state_fingerprint", {}).get("digest")
+
+
+# ----------------------- the real 2-process chaos twin (slow tier)
+
+
+@pytest.fixture(scope="module")
+def real_sdc_run(tmp_path_factory):
+    """train_dist.py --supervise 2 with a silent sdc_grad on host 1:
+    audit divergence within K, replay bisection, quarantine, elastic
+    completion on the survivor — the `make chaos-sdc-smoke` path."""
+    import subprocess
+
+    root = tmp_path_factory.mktemp("sdc")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO), env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    env["CUDA_VISIBLE_DEVICES"] = "-1"
+    p = subprocess.run(
+        [sys.executable, str(REPO / "train_dist.py"),
+         "--supervise", "2", "--platform", "cpu",
+         "--barrier-lead", "3", "--barrier-timeout-s", "60",
+         "--straggler-after-s", "60", "--heartbeat-timeout-s", "300",
+         "--init-timeout-s", "120", "--faults", "sdc_grad@20:host1",
+         "-m", "lenet5", "--epochs", "2", "--synthetic-size", "2048",
+         "--batch-size", "64", "--steps-per-epoch", "16",
+         "--sentinel", "--audit-every", "8",
+         "--workdir", str(root)],
+        env=env, capture_output=True, text=True, timeout=1500)
+    return p, root
+
+
+def test_two_host_sdc_quarantine_end_to_end(real_sdc_run):
+    p, root = real_sdc_run
+    out = p.stdout
+    assert p.returncode == 0, out[-4000:] + p.stderr[-2000:]
+    # detection within K=8 of the step-20 corruption (audit step 24)
+    assert "fingerprints disagree at audit step 24" in out
+    # attribution: exactly one replay (ceil(log2 2)), host 1 named
+    assert "QUARANTINED host 1" in out
+    assert "replay 1:" in out and "replay 2:" not in out
+    ledger = json.loads((root / "excluded_hosts.json").read_text())
+    assert [e["host"] for e in ledger["excluded"]] == [1]
+    # the survivor finished the job
+    assert "gen 1: launching hosts [0]" in out
+    assert "trips=0" in out and "divergences=1" in out \
+        and "quarantined=1" in out

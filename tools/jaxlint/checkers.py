@@ -1,4 +1,4 @@
-"""The jaxlint checker set (JX101–JX115).
+"""The jaxlint checker set (JX101–JX116).
 
 Each checker targets one class of TPU step-time/correctness hazard that
 pytest cannot see (the program stays *correct* — it just recompiles,
@@ -1194,3 +1194,144 @@ class ClusterTimeoutChecker(Checker):
                 "process forever; pass initialization_timeout/"
                 "timeout_in_ms/timeout_s (supervisors must be able "
                 "to degrade, resilience/cluster.py)")
+
+
+_SENTINEL_FETCHERS = {"float", "int"}
+
+
+@register_checker
+class SentinelFetchChecker(Checker):
+    """Per-step host fetch of the in-graph sentinel outputs: the
+    sentinel scalars (``sent_*``, resilience/sentinel.py) are computed
+    INSIDE the compiled step precisely so they can ride the existing
+    pending/drain fetch cadence for free — a ``float()`` /
+    ``np.asarray`` / ``jax.device_get`` / ``.item()`` of one INSIDE
+    the step loop parks the host on the dispatch queue every step,
+    re-introducing the JX109 stall the async feed exists to avoid (and
+    the <2% sentinel overhead gate is measured WITHOUT such a sync).
+    A fetch under a cadence guard (an ``if`` whose test uses ``%`` —
+    the ``i % k == 0`` drain idiom) is the sanctioned exception. Which
+    functions count as sentinel-consuming step loops is the
+    ``sentinel_funcs`` knob (``jaxlint.toml``)."""
+
+    code = "JX116"
+    name = "per-step-sentinel-fetch"
+    description = ("float()/np.asarray/device_get/.item() of a sent_* "
+                   "sentinel output inside a step loop, outside the "
+                   "drain cadence (re-introduces the JX109 host-sync "
+                   "stall)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.sentinel_funcs
+        step_patterns = mod.cfg.checked_step_funcs
+        flagged: set[int] = set()  # nested loops: report a call once
+        for info in mod.functions:
+            if not any(fnmatch.fnmatch(info.node.name, p)
+                       for p in patterns):
+                continue
+            for loop in ast.walk(info.node):
+                if not isinstance(loop, (ast.For, ast.AsyncFor,
+                                         ast.While)):
+                    continue
+                if not self._has_step_call(loop, step_patterns):
+                    continue
+                guarded = self._cadence_guarded_ids(loop)
+                for sub in self._direct_body_nodes(loop):
+                    if not isinstance(sub, ast.Call) \
+                            or id(sub) in flagged \
+                            or id(sub) in guarded:
+                        continue
+                    if not self._is_fetch(sub):
+                        continue
+                    if not self._touches_sentinel(sub):
+                        continue
+                    flagged.add(id(sub))
+                    yield mod.finding(
+                        sub, self.code,
+                        f"'{call_name(sub) or '.item()'}' fetches "
+                        "a sent_* sentinel output on EVERY step "
+                        "of the loop in "
+                        f"'{info.node.name}' — a per-step host "
+                        "sync (JX109's stall) the in-graph "
+                        "sentinels exist to avoid; batch it "
+                        "through the pending/drain pattern or "
+                        "guard it with the drain cadence "
+                        "(`if i % k == 0:`)")
+
+    @staticmethod
+    def _direct_body_nodes(loop):
+        """Nodes of ``loop``'s body WITHOUT descending into nested
+        loops: a nested loop is its own iteration scope and gets its
+        own visit (a fetch sitting after an inner step loop runs once
+        per OUTER iteration — the sanctioned batch point, not a
+        per-step sync)."""
+        stack = list(loop.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue  # the nested loop's body is its own scope
+            stack.extend(ast.iter_child_nodes(node))
+
+    @classmethod
+    def _has_step_call(cls, loop, step_patterns) -> bool:
+        """A compiled-step call DIRECTLY in this loop's body (a step
+        call only inside a nested loop makes the NESTED loop the
+        per-step scope, not this one)."""
+        for sub in cls._direct_body_nodes(loop):
+            if isinstance(sub, ast.Call):
+                la = last_attr(call_name(sub))
+                if la and any(fnmatch.fnmatch(la, p)
+                              for p in step_patterns):
+                    return True
+        return False
+
+    @staticmethod
+    def _cadence_guarded_ids(loop) -> set[int]:
+        """ids of calls under an ``if`` whose test contains ``%`` —
+        the ``i % cadence == 0`` drain-cadence idiom."""
+        guarded: set[int] = set()
+        for stmt in ast.walk(loop):
+            if not isinstance(stmt, ast.If):
+                continue
+            has_mod = any(isinstance(op, ast.BinOp)
+                          and isinstance(op.op, ast.Mod)
+                          for op in ast.walk(stmt.test))
+            if not has_mod:
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    guarded.add(id(sub))
+        return guarded
+
+    @staticmethod
+    def _is_fetch(call: ast.Call) -> bool:
+        name = call_name(call)
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in _SENTINEL_FETCHERS:
+            return True
+        if is_host_blocking_call(call):
+            return True
+        return bool(name) and last_attr(name) in ("item", "device_get")
+
+    @staticmethod
+    def _touches_sentinel(call: ast.Call) -> bool:
+        """The fetched expression names a sentinel output — the
+        ``sent_*`` naming contract, in a subscript key, attribute, or
+        variable name."""
+        targets = list(call.args) + [k.value for k in call.keywords]
+        if isinstance(call.func, ast.Attribute):  # x["sent_y"].item()
+            targets.append(call.func.value)
+        for arg in targets:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str) \
+                        and sub.value.startswith("sent_"):
+                    return True
+                if isinstance(sub, ast.Name) \
+                        and sub.id.startswith("sent_"):
+                    return True
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr.startswith("sent_"):
+                    return True
+        return False

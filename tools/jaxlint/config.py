@@ -296,6 +296,15 @@ class LintConfig:
         "*sync_global_devices*", "*await_all_arrived*",
         "*blocking_key_value_get*",
     ])
+    # Function-name patterns treated as sentinel-consuming step loops
+    # (JX116): a per-step float()/np.asarray()/device_get of the
+    # in-graph sentinel outputs (the `sent_*` naming contract of
+    # resilience/sentinel.py) re-introduces the JX109 host-sync stall
+    # the pending/drain pattern exists to avoid — sentinel fetches
+    # must ride the drain cadence (an `i % k` guarded block) instead.
+    sentinel_funcs: list[str] = field(default_factory=lambda: [
+        "*epoch*", "*fit*", "*train_loop*", "*step_loop*",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -316,7 +325,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "key_fresheners", "key_name_patterns", "constraint_funcs",
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
         "timed_funcs", "loop_sleep_funcs", "wire_funcs",
-        "cluster_funcs", "disable",
+        "cluster_funcs", "sentinel_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

@@ -127,6 +127,28 @@ def parse_args():
                         "fail-fast paths")
     p.add_argument("--fault-seed", type=int, default=0,
                    help="seed for probabilistic (~) fault specs")
+    p.add_argument("--sentinel", action="store_true",
+                   help="silent-failure defense (resilience/sentinel"
+                        ".py): cheap numeric invariants (update/param "
+                        "norms + loss) computed INSIDE the compiled "
+                        "step and z-scored on the drain cadence; under "
+                        "a cluster (train_dist.py --supervise) adds "
+                        "the cross-host state-agreement audit, and "
+                        "every checkpoint manifest gains the save-time "
+                        "state fingerprint (audited checkpoints)")
+    p.add_argument("--audit-every", type=int, default=16,
+                   help="run-step cadence of the cross-host state "
+                        "fingerprint audit (and the worst-case SDC "
+                        "detection latency, in steps); requires "
+                        "--sentinel")
+    p.add_argument("--sentinel-z", type=float, default=8.0,
+                   help="z-score threshold of the sentinel EWMA "
+                        "anomaly detector (trips feed the --recover "
+                        "rollback, or fail fast without it)")
+    p.add_argument("--sentinel-warmup", type=int, default=16,
+                   help="observations per sentinel series before the "
+                        "z-test arms (a cold variance estimate trips "
+                        "on everything)")
     p.add_argument("--no-ckpt-integrity", action="store_true",
                    help="skip the per-save checksum manifest (one "
                         "SHA-256 pass over each committed checkpoint) "
@@ -284,16 +306,41 @@ def main():
                                   lr_rewarm=args.lr_rewarm)
     injector = None
     if args.faults:
+        import os as _os
+
         from deepvision_tpu.resilience import FaultInjector
 
-        injector = FaultInjector(args.faults, seed=args.fault_seed)
+        # the ':hostH'-targeted sdc sites key on the ORIGINAL cluster
+        # host id (stable across elastic relaunches); supervisor
+        # replays run quiesced so the replayed window is ground truth
+        orig_host = _os.environ.get("DVTPU_CLUSTER_ORIG_HOST")
+        injector = FaultInjector(
+            args.faults, seed=args.fault_seed,
+            host=int(orig_host) if orig_host is not None else None,
+            sdc_quiesce=bool(_os.environ.get("DVTPU_SDC_QUIESCE")))
         print(f"fault injection armed: {args.faults!r}", flush=True)
+    sentinel = None
+    if args.sentinel:
+        import os as _os
+
+        from deepvision_tpu.resilience.sentinel import SentinelMonitor
+
+        replay = _os.environ.get("DVTPU_SENTINEL_REPLAY")
+        sentinel = SentinelMonitor(
+            z_threshold=args.sentinel_z, warmup=args.sentinel_warmup,
+            audit_every=args.audit_every,
+            replay_until=int(replay) if replay else None)
+        print("[sentinel] armed: in-graph invariants + EWMA z-score "
+              f"(z={args.sentinel_z:g}, warmup={args.sentinel_warmup})"
+              f", state audits every {args.audit_every} steps"
+              + (f"; REPLAY mode through run step {replay}"
+                 if replay else ""), flush=True)
     if cfg["dataset"].startswith("gan"):
-        if args.recover or args.faults:
+        if args.recover or args.faults or args.sentinel:
             raise SystemExit(
-                "--recover/--faults ride the Trainer rollback loop; the "
-                "GAN fit_gan path has no checkpoint-rollback hook yet "
-                f"(this run: {args.model!r})")
+                "--recover/--faults/--sentinel ride the Trainer "
+                "rollback/drain loop; the GAN fit_gan path has no "
+                f"hook yet (this run: {args.model!r})")
         if args.profile_steps or args.profile_dir:
             raise SystemExit(
                 "--profile-steps/--profile-dir ride the Trainer step "
@@ -539,6 +586,7 @@ def main():
         stall_abort=args.stall_abort,
         rss_limit_gb=args.rss_limit_gb or None,
         recovery=recovery, fault_injector=injector,
+        sentinel=sentinel,
         ckpt_integrity=not args.no_ckpt_integrity,
         profile_steps=args.profile_steps, profile_dir=args.profile_dir,
         **step_fns,
@@ -564,12 +612,29 @@ def main():
     # continues bit-identically (SURVEY §5.3 — the reference has no
     # preemption story at all)
     trainer.install_preemption_handler()
+    from deepvision_tpu.resilience.sentinel import (
+        AuditDivergence,
+        SentinelTrip,
+    )
+
     try:
         trainer.fit(args.epochs)
+    except (SentinelTrip, AuditDivergence) as e:
+        # silent-data-corruption verdict: markers are already on the
+        # cluster dir (trip / divergence); exit 76 tells a supervisor
+        # this was an SDC stop, not a crash or a preemption
+        print(f"[sentinel] FATAL: {e}", flush=True)
+        raise SystemExit(76) from e
     finally:
         # export on EVERY exit (preemption and crashes included): a
         # truncated run's trace is exactly the one worth reading
         _maybe_export_trace(args)
+    if trainer.replay_done:
+        # replay-bisection window completed cleanly: the audit files
+        # ARE the verdict; nothing to publish, nothing was saved
+        print("[sentinel] replay verdict recorded; exiting 0",
+              flush=True)
+        return
     if trainer.preempted:
         raise SystemExit(143)
     _maybe_publish(args, f"{args.workdir}/{args.model}/ckpt")

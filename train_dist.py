@@ -101,6 +101,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-relaunches", type=int, default=3,
                    help="supervisor: crash/dead-host relaunch budget "
                         "(graceful preemptions don't consume it)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="supervisor: serve the obs registry as a "
+                        "Prometheus scrape surface on this port "
+                        "(GET /metrics: cluster_host_alive / "
+                        "cluster_step_lag liveness gauges + the "
+                        "sentinel_* SDC counters; 0 = ephemeral)")
     return p
 
 
@@ -127,6 +133,16 @@ def run_supervisor(dist_args, train_argv) -> int:
             train_argv = [*train_argv, "--faults", rest,
                           "--fault-seed", str(dist_args.fault_seed)]
     workdir = argv_value(train_argv, "--workdir") or "runs"
+    server = None
+    if dist_args.metrics_port is not None:
+        # the multi-host scrape surface: the ledger's liveness gauges
+        # and the sentinel_* SDC counters land in the default registry,
+        # which this endpoint renders (obs/metrics.py exposition)
+        from deepvision_tpu.obs.metrics import start_exposition_server
+
+        server, port = start_exposition_server(dist_args.metrics_port)
+        print(f"[cluster] Prometheus metrics on :{port}/metrics",
+              flush=True)
     sup = ClusterSupervisor(
         train_argv, dist_args.supervise, workdir,
         launcher=__file__,
@@ -141,7 +157,11 @@ def run_supervisor(dist_args, train_argv) -> int:
         barrier_timeout_s=dist_args.barrier_timeout_s,
         max_relaunches=dist_args.max_relaunches,
     )
-    return sup.run()
+    try:
+        return sup.run()
+    finally:
+        if server is not None:
+            server.shutdown()
 
 
 def run_worker(dist_args, train_argv) -> None:
