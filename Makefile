@@ -126,6 +126,19 @@ obs-smoke:
 	$(PY) tools/obs_smoke.py 2>&1 | tee -a "$$L" && \
 	echo "obs-smoke OK (trace attribution + /metrics exposition)"
 
+# fleet observability smoke: boot a REAL 2-replica lenet process fleet
+# with span spooling on, serve a short HTTP load, then assert the three
+# distributed-obs contracts on live artifacts (tools/obs_fleet_smoke.py):
+# federated /metrics sums child request counters exactly with
+# per-replica labels, tools/trace_merge.py assembles the processes'
+# spools into ONE Perfetto trace with >= 1 request's flow crossing the
+# router and a replica row, and every process left a flight-recorder
+# black box on SIGTERM — the `make check` fleet-observability gate
+obs-fleet-smoke:
+	@mkdir -p logs; L="logs/obs-fleet-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	$(PY) tools/obs_fleet_smoke.py 2>&1 | tee "$$L" && \
+	grep -q "obs-fleet-smoke OK" "$$L"
+
 # input-pipeline smoke: drive the REAL record readers + prefetcher on a
 # tiny self-built JPEG record set and assert the split pipeline's wire
 # contract (ISSUE 7): uint8 crossing H2D, measured h2d_bytes_per_image
@@ -205,7 +218,7 @@ chaos-sdc-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke router-smoke obs-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke
+check: lint serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -329,4 +342,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-ir bf16-ready check serve-smoke router-smoke obs-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-ir bf16-ready check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke bench dryrun tensorboard find-python list-models rehearsal

@@ -1572,12 +1572,25 @@ def serve_sweep_bench() -> dict:
 if __name__ == "__main__":
 
     # BENCH_TRACE=path: span-trace the bench itself (the feed loops
-    # carry fetch/host_next/shard spans) and export Chrome trace JSON
+    # carry fetch/host_next/shard spans) and export Chrome trace JSON.
+    # BENCH_TRACE_SPOOL=dir additionally spools spans crash-safe (and
+    # picks up the decode workers' host_decode rows), mergeable with a
+    # co-running fleet's spools via tools/trace_merge.py
     _trace_path = os.environ.get("BENCH_TRACE")
+    _spool = None
     if _trace_path:
         from deepvision_tpu.obs.trace import get_tracer
 
         get_tracer().enable()
+    _spool_dir = os.environ.get("BENCH_TRACE_SPOOL")
+    if _spool_dir:
+        from deepvision_tpu.obs.distributed import ENV_SPOOL, SpanSpool
+        from deepvision_tpu.obs.trace import get_tracer
+
+        get_tracer().set_labels(role="bench")
+        _spool = SpanSpool(_spool_dir, role="bench")
+        # the mp decode workers inherit this and spool beside us
+        os.environ[ENV_SPOOL] = _spool_dir
     try:
         if "cluster" in sys.argv[1:]:
             print(json.dumps(cluster_bench()))
@@ -1595,5 +1608,12 @@ if __name__ == "__main__":
         # crashed bench's partial trace is the one worth reading
         if _trace_path:
             _n = get_tracer().export(_trace_path)
-            print(f"# wrote {_n} spans to {_trace_path}",
+            _dropped = get_tracer().dropped_spans
+            print(f"# wrote {_n} spans to {_trace_path}"
+                  + (f" (RING OVERFLOW: {_dropped} spans dropped — "
+                     "the trace is truncated; see the export's "
+                     "metadata.trace_dropped_spans)"
+                     if _dropped else ""),
                   file=sys.stderr)
+        if _spool is not None:
+            _spool.close()

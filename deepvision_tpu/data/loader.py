@@ -200,11 +200,35 @@ def _worker_main(factory, worker_id: int, num_workers: int, queue,
         return (_SHM, (idx, ring.segs[idx].name,
                        ring.dump(idx, arrays), extras))
 
+    # distributed tracing (obs/distributed.py): a spawned decode worker
+    # is its own process, invisible to the parent's tracer — when the
+    # launch env names a spool dir (DVTPU_TRACE_SPOOL, exported by the
+    # cluster supervisor / serve fleet / an operator), its host_decode
+    # spans spool there and tools/trace_merge.py gives the worker pool
+    # its own pid rows on the merged timeline. No env, no cost.
+    spool = None
+    try:
+        from deepvision_tpu.obs.distributed import enable_spool_from_env
+        from deepvision_tpu.obs.trace import span as _span
+
+        spool = enable_spool_from_env(role=f"decode-w{worker_id}")
+    except Exception:  # observability must never kill a decode worker
+        def _span(*a, **kw):
+            from contextlib import nullcontext
+
+            return nullcontext()
     try:
         stream = factory(worker_id, num_workers)
         if skip:
             stream = islice(stream, skip, None)
-        for batch in stream:
+        it = iter(stream)
+        while True:
+            try:
+                with _span("host_decode", cat="feed",
+                           args={"worker": worker_id}):
+                    batch = next(it)
+            except StopIteration:
+                break
             encoded = encode(batch)
             if encoded is None or not put((_BATCH, encoded)):
                 return
@@ -213,6 +237,8 @@ def _worker_main(factory, worker_id: int, num_workers: int, queue,
         put((_ERROR, f"loader worker {worker_id}/{num_workers} died:\n"
              + traceback.format_exc()))
     finally:
+        if spool is not None:
+            spool.close()
         if ring and not ring_sent:
             # the parent never learned these names (stopped before the
             # handshake landed): still ours, reclaim them here

@@ -20,6 +20,13 @@ each had its own locks, deques, naming, and export path):
                  --profile-steps A:B``, ``serve.py --profile-dir``) and
                  ``mem_*`` device-memory gauges from
                  ``memory_stats()`` (graceful no-op on CPU).
+- ``distributed``: the fleet/cluster layer — trace-id propagation over
+                 the HTTP hop (``X-DVTPU-Trace``), crash-safe
+                 per-process span spools merged by
+                 ``tools/trace_merge.py`` into one Perfetto timeline,
+                 federated Prometheus rendering (exact counter sums +
+                 reservoir-merged histograms with per-child labels),
+                 and the always-on crash flight recorder.
 
 The four telemetry objects now register their metrics here at
 construction, so train-feed, serve-latency, recovery, and memory
@@ -28,6 +35,19 @@ metric name, ``/stats`` JSON key, and grep-stable log line stays
 byte-compatible.
 """
 
+from deepvision_tpu.obs.distributed import (
+    TRACE_HEADER,
+    FlightRecorder,
+    SpanSpool,
+    enable_spool_from_env,
+    flight_dump,
+    get_flight_recorder,
+    install_flight_recorder,
+    new_trace_id,
+    parse_prometheus,
+    read_spool,
+    render_federated,
+)
 from deepvision_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -50,6 +70,17 @@ from deepvision_tpu.obs.trace import (
 )
 
 __all__ = [
+    "TRACE_HEADER",
+    "FlightRecorder",
+    "SpanSpool",
+    "enable_spool_from_env",
+    "flight_dump",
+    "get_flight_recorder",
+    "install_flight_recorder",
+    "new_trace_id",
+    "parse_prometheus",
+    "read_spool",
+    "render_federated",
     "Counter",
     "Gauge",
     "Histogram",

@@ -1,0 +1,555 @@
+"""Fleet-wide observability: trace propagation, span spools, federated
+metrics, and the crash flight recorder.
+
+The PR 5 obs stack (``metrics.py``/``trace.py``) is strictly
+in-process, but everything built since is multi-process: the serving
+fleet is a router over N replica processes, training is a supervisor
+over N hosts plus decode-worker pools, and the SDC machinery
+quarantines hosts whose last moments nobody could replay from
+telemetry. This module is the cross-process layer on top of the same
+primitives — four cooperating pieces:
+
+**Trace-context propagation.** Every routed request gets a trace id at
+the router (:func:`new_trace_id`), carried over the HTTP hop in the
+``X-DVTPU-Trace`` header (:data:`TRACE_HEADER`; the stdin-JSONL surface
+takes a ``"trace"`` field) into the replica's queue/device/postprocess
+spans — so one request's spans share one id across processes. Cluster
+jobs stamp their tracer with ``(host, generation)`` labels
+(:func:`cluster_labels_from_env`), so one training step is correlatable
+across hosts of any generation.
+
+**Per-process span spools.** :class:`SpanSpool` attaches to the tracer
+as a sink and appends every completed span to a crash-safe JSONL file
+(one complete record per line — a SIGKILL can tear at most the final
+line, which the reader tolerates), bounded by two-file rotation so a
+long run's spool is a ring, not a leak. The header line calibrates the
+tracer's monotonic clock against this process's wall clock
+(``epoch_wall``), which is what lets ``tools/trace_merge.py`` assemble
+spools from N processes into ONE Perfetto timeline with correct
+cross-process ordering.
+
+**Federated metrics.** A parent (the fleet router, the cluster
+supervisor) scrapes its children's typed registry dumps
+(:meth:`Registry.dump` — histogram RESERVOIRS included, not lossy
+quantiles) and :func:`render_federated` re-exports one aggregated
+Prometheus surface: exact sums for counters, sample-merged reservoirs
+for histogram quantiles, per-child series labelled
+``{replica="r1"}`` / ``{host="0"}`` — one ``curl :PORT/metrics``
+describes the whole fleet.
+
+**Flight recorder.** :class:`FlightRecorder` keeps an always-on bounded
+ring of recent spans (a tracer sink — no export machinery needed) plus
+metric-delta notes, and dumps it to the workdir on SIGTERM, dispatcher
+crash, sentinel trip, or SDC divergence — so every PR 10 verdict ships
+with a black box of the culprit's last K steps. For a SIGKILLed process
+(no handler can run) the spool IS the surviving black box: the cluster
+supervisor extracts the culprit's spool tail into a quarantine dump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import uuid
+from collections import deque
+from pathlib import Path
+
+from deepvision_tpu.obs.metrics import (
+    Registry,
+    default_registry,
+    histogram_export,
+    render_family,
+)
+from deepvision_tpu.obs.trace import Tracer, get_tracer
+
+__all__ = [
+    "ENV_SPOOL",
+    "TRACE_HEADER",
+    "FlightRecorder",
+    "SpanSpool",
+    "cluster_labels_from_env",
+    "enable_spool_from_env",
+    "flight_dump",
+    "get_flight_recorder",
+    "install_flight_recorder",
+    "merge_histograms",
+    "new_trace_id",
+    "parse_prometheus",
+    "read_spool",
+    "render_federated",
+    "spool_paths",
+]
+
+# the spool directory hand-off: a parent (serve.py --trace-spool, the
+# cluster supervisor) exports this; children attach a SpanSpool there
+ENV_SPOOL = "DVTPU_TRACE_SPOOL"
+# the HTTP hop carrier of the trace id (router -> replica)
+TRACE_HEADER = "X-DVTPU-Trace"
+_SPOOL_PREFIX = "trace-spool-"
+
+
+def new_trace_id() -> str:
+    """Fleet-unique request trace id (128-bit uuid, 16 hex chars is
+    plenty at serving rates)."""
+    return uuid.uuid4().hex[:16]
+
+
+def cluster_labels_from_env(environ=os.environ) -> dict:
+    """Process identity labels from the cluster launch env: the stable
+    ORIGINAL host id (not the generation-local index) and the
+    generation, so spans from any relaunch correlate to the same
+    physical host row."""
+    out: dict = {}
+    host = environ.get("DVTPU_CLUSTER_ORIG_HOST",
+                       environ.get("DVTPU_CLUSTER_HOST"))
+    if host is not None:
+        out["host"] = int(host)
+    gen = environ.get("DVTPU_CLUSTER_GEN")
+    if gen is not None:
+        try:
+            out["generation"] = int(gen)
+        except ValueError:
+            out["generation"] = gen  # "gen-003" / "replay-001" names
+    return out
+
+
+# --------------------------------------------------------------- spools
+
+
+class SpanSpool:
+    """Crash-safe per-process span spool: a tracer sink appending one
+    JSON record per completed span.
+
+    - **crash-safe append**: every line is a complete record written in
+      one ``write`` + flush; a SIGKILL tears at most the final line and
+      :func:`read_spool` tolerates it. This is what makes the spool the
+      surviving black box of a killed process.
+    - **bounded**: at ``max_bytes`` the file rotates to ``<name>.1``
+      (previous ``.1`` dropped) — a two-file ring, so long training
+      runs spool forever in bounded disk.
+    - **calibrated**: header lines record ``epoch_wall`` — the wall
+      time of the tracer's monotonic zero — re-emitted whenever the
+      tracer is re-epoched (``clear()``), so the merger can place every
+      span on the fleet-wide wall timeline.
+    """
+
+    def __init__(self, directory: str | Path, *, role: str | None = None,
+                 tracer: Tracer | None = None,
+                 max_bytes: int = 8 << 20):
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self._dir = Path(directory)
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self.role = role or self._tracer.labels.get("role") or "proc"
+        safe = "".join(c if c.isalnum() or c in "-_." else "-"
+                       for c in str(self.role))
+        self.path = self._dir / f"{_SPOOL_PREFIX}{safe}-{os.getpid()}.jsonl"
+        self._max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._file = open(self.path, "a", encoding="utf-8")
+        self._size = self.path.stat().st_size
+        self._epoch_wall = None
+        self._write_header()
+        self._tracer.add_sink(self._sink)
+
+    def _write_header(self) -> None:
+        self._epoch_wall = self._tracer.epoch_wall
+        self._write_line({
+            "spool": 1, "pid": os.getpid(), "role": self.role,
+            "labels": self._tracer.labels,
+            "epoch_wall": self._epoch_wall, "time": time.time(),
+        })
+
+    def _write_line(self, rec: dict) -> None:
+        line = json.dumps(rec) + "\n"
+        self._file.write(line)
+        self._file.flush()
+        self._size += len(line)
+
+    def _sink(self, rec: dict) -> None:
+        with self._lock:
+            if self._file.closed:
+                return
+            if self._tracer.epoch_wall != self._epoch_wall:
+                self._write_header()  # tracer re-epoched: recalibrate
+            self._write_line(rec)
+            if self._size > self._max_bytes:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        self._file.close()
+        os.replace(self.path, self.path.with_name(self.path.name + ".1"))
+        self._file = open(self.path, "a", encoding="utf-8")
+        self._size = 0
+        self._write_header()
+
+    def close(self) -> None:
+        self._tracer.remove_sink(self._sink)
+        with self._lock:
+            if not self._file.closed:
+                self._file.close()
+
+    def __enter__(self) -> "SpanSpool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def enable_spool_from_env(role: str | None = None,
+                          labels: dict | None = None,
+                          environ=os.environ) -> SpanSpool | None:
+    """The child-process hook: when :data:`ENV_SPOOL` names a
+    directory, label the process tracer and attach a spool there (spans
+    then record via the sink path even with the in-memory ring off).
+    Returns the spool, or None when spooling is not requested."""
+    d = environ.get(ENV_SPOOL)
+    if not d:
+        return None
+    tracer = get_tracer()
+    merged = {**cluster_labels_from_env(environ), **(labels or {})}
+    if role is not None:
+        merged.setdefault("role", role)
+    tracer.set_labels(**merged)
+    return SpanSpool(d, role=merged.get("role"), tracer=tracer)
+
+
+def spool_paths(root: str | Path) -> list[Path]:
+    """Every spool file (rotated ``.1`` halves included) under
+    ``root``, recursively — the merger's collection sweep."""
+    root = Path(root)
+    if root.is_file():
+        return [root]
+    return sorted(p for p in root.rglob(f"{_SPOOL_PREFIX}*.jsonl*")
+                  if p.is_file())
+
+
+def read_spool(path: str | Path) -> dict:
+    """Parse one spool file -> ``{"headers": [...], "events": [...]}``.
+    Every event carries ``wall`` (seconds, wall clock) computed from
+    the governing calibration header, so events from different
+    processes are directly comparable. A torn final line (the process
+    was SIGKILLed mid-write) is dropped silently — by construction it
+    is the only possible damage."""
+    headers: list[dict] = []
+    events: list[dict] = []
+    cur: dict | None = None
+    try:
+        text = Path(path).read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return {"headers": [], "events": []}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail line
+        if rec.get("spool") == 1:
+            headers.append(rec)
+            cur = rec
+            continue
+        if cur is not None and "ts" in rec:
+            rec = dict(rec)
+            rec["wall"] = cur.get("epoch_wall", 0.0) + rec["ts"]
+            rec["pid"] = cur.get("pid")
+            rec["role"] = cur.get("role")
+            rec["labels"] = cur.get("labels", {})
+            events.append(rec)
+    return {"headers": headers, "events": events}
+
+
+# --------------------------------------------------- metric federation
+
+
+def merge_histograms(dumps: list[dict]) -> dict:
+    """Merge N histogram dumps into one: exact summed count/total and
+    the CONCATENATED reservoirs, so federated quantiles are computed
+    over every child's samples rather than averaged from per-child
+    quantiles (which is not a meaningful statistic)."""
+    samples: list[float] = []
+    count, total = 0, 0.0
+    for d in dumps:
+        count += int(d.get("count", 0))
+        total += float(d.get("total", 0.0))
+        samples.extend(d.get("samples") or [])
+    return {"type": "histogram", "count": count, "total": total,
+            "samples": samples}
+
+
+def render_federated(children: dict[str, dict], *,
+                     own: Registry | None = None,
+                     label: str = "replica",
+                     own_label: str = "parent") -> str:
+    """One aggregated Prometheus text surface over N children.
+
+    ``children`` maps a label VALUE (replica id, host id) to that
+    child's :meth:`Registry.dump`. Per metric family:
+
+    - **counters**: one ``{label="child"}`` sample per child plus the
+      unlabelled EXACT sum — ``serve_completed_total`` on the router is
+      precisely the fleet's completed count;
+    - **gauges**: per-child samples only (summing a queue depth across
+      replicas is occasionally meaningful, averaging a ratio never is —
+      the reader picks the aggregation);
+    - **histograms**: reservoir-merged quantiles + summed
+      ``_sum``/``_count``, with per-child ``_count`` samples so a
+      lopsided fleet is visible.
+
+    ``own`` adds the parent's OWN registry (router_* / cluster_*
+    families): families whose names don't collide with any child render
+    unlabelled as usual; a colliding family (both sides count
+    ``trace_dropped_spans``) folds the parent in as one more child
+    under ``own_label`` so no name is emitted twice."""
+    table: dict[str, dict] = {}  # name -> {"type", "series": {label: payload}}
+    for child, dump in children.items():
+        for name, payload in (dump or {}).items():
+            fam = table.setdefault(
+                name, {"type": payload.get("type"), "series": {}})
+            if fam["type"] == payload.get("type"):
+                fam["series"][str(child)] = payload
+    own_plain: list[tuple[str, dict]] = []
+    if own is not None:
+        for name, payload in own.dump().items():
+            if name in table:
+                if table[name]["type"] == payload.get("type"):
+                    table[name]["series"][own_label] = payload
+            else:
+                own_plain.append((name, payload))
+
+    lines: list[str] = []
+
+    def fmt(v) -> str:
+        return f"{float(v):.9g}"
+
+    for name, fam in sorted({**dict(own_plain), **table}.items()):
+        if name not in table:
+            # non-colliding parent family: the standard unlabelled
+            # format, from the same renderer metrics.py uses
+            lines.extend(render_family(name, dict(own_plain)[name]))
+            continue
+        t, series = fam["type"], fam["series"]
+        if t == "counter":
+            lines.append(f"# TYPE {name}_total counter")
+            for child in sorted(series):
+                lines.append(
+                    f'{name}_total{{{label}="{child}"}} '
+                    f"{int(series[child]['value'])}")
+            lines.append(f"{name}_total "
+                         f"{sum(int(p['value']) for p in series.values())}")
+        elif t == "gauge":
+            lines.append(f"# TYPE {name} gauge")
+            for child in sorted(series):
+                lines.append(f'{name}{{{label}="{child}"}} '
+                             f"{fmt(series[child]['value'])}")
+        elif t == "histogram":
+            merged = merge_histograms(list(series.values()))
+            ex = histogram_export(merged)
+            lines.append(f"# TYPE {name} summary")
+            for q, v in ex["quantiles"].items():
+                lines.append(f'{name}{{quantile="{q:g}"}} {fmt(v)}')
+            for child in sorted(series):
+                lines.append(f'{name}_count{{{label}="{child}"}} '
+                             f"{int(series[child].get('count', 0))}")
+            lines.append(f"{name}_sum {fmt(ex['sum'])}")
+            lines.append(f"{name}_count {ex['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """Parse the text exposition this module (and ``metrics.py``)
+    renders: ``{series_name: [(labels_dict, value), ...]}``. The
+    verification half of federation — smokes and tests re-derive the
+    sums from the scraped text instead of trusting the renderer."""
+    out: dict[str, list[tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(None, 1)
+            value = float(val)
+        except ValueError:
+            continue
+        labels: dict = {}
+        name = key
+        if "{" in key and key.endswith("}"):
+            name, _, rest = key.partition("{")
+            for pair in rest[:-1].split(","):
+                if "=" in pair:
+                    k, _, v = pair.partition("=")
+                    labels[k.strip()] = v.strip().strip('"')
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+# ------------------------------------------------------ flight recorder
+
+
+class FlightRecorder:
+    """Always-on bounded black box: the last ``capacity`` span records
+    (a tracer sink — active even when the export ring is off) plus
+    metric-delta notes, dumped to the workdir when the process dies
+    loudly enough to tell someone.
+
+    ``note(label, step=...)`` appends a marker carrying the counter
+    DELTAS since the previous note (gauges ride as absolute values) —
+    called on cheap existing cadences (the cluster heartbeat, the serve
+    dispatch loop), it turns the ring into "what the process was doing,
+    step by step, right before the end".
+
+    ``dump(reason)`` writes one atomic JSON file
+    (``flightrec-<tag>-<reason>.json``) with the ring, the full
+    registry snapshot, and the tracer labels/calibration —
+    ``tools/trace_merge.py`` folds these into a merged timeline like
+    any spool. Triggers wired by the callers: SIGTERM
+    (:meth:`install_signals`), dispatcher crash (serve engine), sentinel
+    trip / SDC divergence (cluster member). SIGKILL runs no handler by
+    definition — the spool tail is the surviving record there, and the
+    cluster supervisor extracts it at quarantine time."""
+
+    def __init__(self, directory: str | Path | None = None, *,
+                 capacity: int = 512,
+                 registry: Registry | None = None,
+                 tracer: Tracer | None = None,
+                 meta: dict | None = None):
+        self._dir = Path(directory) if directory is not None else None
+        self._registry = (registry if registry is not None
+                          else default_registry())
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self.meta = dict(meta or {})
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._last_counters: dict[str, float] = {}
+        self._dumps = 0
+        self._tracer.add_sink(self._sink)
+
+    def _sink(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append({"kind": "span", **rec})
+
+    def note(self, label: str, step: int | None = None, **fields) -> None:
+        """Append a marker with metric deltas since the last note.
+        Scalars only — copying histogram reservoirs on a heartbeat
+        cadence would make the black box the overhead story."""
+        deltas: dict = {}
+        for name, kind, payload in self._registry.collect(
+                scalars_only=True):
+            if kind == "counter":
+                d = payload - self._last_counters.get(name, 0)
+                self._last_counters[name] = payload
+                if d:
+                    deltas[name] = d
+            elif kind == "gauge" and payload:
+                deltas[name] = payload
+        rec = {"kind": "note", "t": time.time(), "label": label,
+               "metrics": deltas, **fields}
+        if step is not None:
+            rec["step"] = int(step)
+        with self._lock:
+            self._ring.append(rec)
+
+    def dump(self, reason: str, directory: str | Path | None = None
+             ) -> Path | None:
+        """Atomically write the black box; returns the path (None when
+        no directory was ever configured). Never raises — a failing
+        dump must not mask the failure being recorded."""
+        try:
+            d = Path(directory) if directory is not None else self._dir
+            if d is None:
+                return None
+            d.mkdir(parents=True, exist_ok=True)
+            labels = self._tracer.labels
+            tag = labels.get("role") or self.meta.get("role") or "proc"
+            if labels.get("host") is not None:
+                tag = f"host{labels['host']}"
+            self._dumps += 1
+            safe_reason = "".join(c if c.isalnum() or c in "-_" else "-"
+                                  for c in reason)
+            path = d / (f"flightrec-{tag}-{safe_reason}-"
+                        f"{os.getpid()}-{self._dumps}.json")
+            with self._lock:
+                events = list(self._ring)
+            body = {
+                "flightrec": 1,
+                "reason": reason,
+                "time": time.time(),
+                "pid": os.getpid(),
+                "meta": self.meta,
+                "labels": labels,
+                "epoch_wall": self._tracer.epoch_wall,
+                "events": events,
+                "snapshot": self._registry.snapshot(),
+            }
+            tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+            tmp.write_text(json.dumps(body))
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+    def install_signals(self, *signums) -> None:
+        """Dump on delivery of ``signums`` (default SIGTERM), then
+        CHAIN to the previous disposition — the preemption handler a
+        trainer already installed still runs; a default disposition is
+        re-raised so the process still dies. Main thread only (a
+        CPython constraint on ``signal.signal``)."""
+        for signum in (signums or (signal.SIGTERM,)):
+            prev = signal.getsignal(signum)
+
+            def _handler(sig, frame, prev=prev):
+                self.dump(f"signal-{sig}")
+                if callable(prev):
+                    prev(sig, frame)
+                elif prev == signal.SIG_DFL:
+                    signal.signal(sig, signal.SIG_DFL)
+                    os.kill(os.getpid(), sig)
+                # SIG_IGN: swallow, as before
+
+            signal.signal(signum, _handler)
+
+    def close(self) -> None:
+        self._tracer.remove_sink(self._sink)
+
+
+_FLIGHT: FlightRecorder | None = None
+
+
+def install_flight_recorder(directory: str | Path | None, *,
+                            capacity: int = 512,
+                            meta: dict | None = None,
+                            signals: tuple = (),
+                            registry: Registry | None = None
+                            ) -> FlightRecorder | None:
+    """Create + register the process flight recorder (replacing any
+    previous one). ``signals`` additionally installs dump-on-signal
+    handlers (main thread only). A ``None`` directory uninstalls
+    instead: a recorder with nowhere to dump would still pay the
+    span-recording hot path (its tracer sink activates tracing) for a
+    black box that can never be written."""
+    global _FLIGHT
+    if _FLIGHT is not None:
+        _FLIGHT.close()
+        _FLIGHT = None
+    if directory is None:
+        return None
+    _FLIGHT = FlightRecorder(directory, capacity=capacity, meta=meta,
+                             registry=registry)
+    if signals:
+        _FLIGHT.install_signals(*signals)
+    return _FLIGHT
+
+
+def get_flight_recorder() -> FlightRecorder | None:
+    return _FLIGHT
+
+
+def flight_dump(reason: str) -> Path | None:
+    """Dump the process flight recorder if one is installed — the
+    one-liner failure paths call (dispatcher crash, sentinel trip, SDC
+    divergence) without caring whether observability is wired."""
+    rec = _FLIGHT
+    return rec.dump(reason) if rec is not None else None

@@ -51,6 +51,10 @@ __all__ = [
     "Histogram",
     "Registry",
     "default_registry",
+    "histogram_export",
+    "histogram_summary",
+    "render_family",
+    "start_exposition_server",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -147,37 +151,25 @@ class Histogram:
         with self._lock:
             return self._total
 
+    def dump(self) -> dict:
+        """Typed raw view INCLUDING the reservoir samples — the wire
+        format of cross-process metric federation
+        (``obs/distributed.py``): a parent merges children's reservoirs
+        sample-for-sample instead of trying to average quantiles, so
+        the federated percentiles are exactly what one process
+        observing every sample would report."""
+        with self._lock:
+            return {"type": "histogram", "count": self._count,
+                    "total": self._total,
+                    "samples": [float(s) for s in self._samples]}
+
     def export(self, qs=(0.5, 0.95, 0.99)) -> dict:
         """Base-unit (seconds) view for the Prometheus rendering: one
         locked read yields a coherent (count, sum, quantiles) triple."""
-        with self._lock:
-            samples = list(self._samples)
-            count, total = self._count, self._total
-        if samples:
-            arr = np.asarray(samples, np.float64)
-            vals = np.percentile(arr, [q * 100.0 for q in qs])
-            quant = {q: float(v) for q, v in zip(qs, vals)}
-        else:
-            quant = {q: 0.0 for q in qs}
-        return {"count": count, "sum": total, "quantiles": quant}
+        return histogram_export(self.dump(), qs)
 
     def summary(self) -> dict:
-        with self._lock:
-            samples = list(self._samples)
-            count, total = self._count, self._total
-        if not samples:
-            return {"count": count, "mean_ms": 0.0, "p50_ms": 0.0,
-                    "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
-        arr = np.asarray(samples, np.float64) * 1e3
-        p50, p95, p99 = np.percentile(arr, [50, 95, 99])
-        return {
-            "count": count,
-            "mean_ms": round(total / max(1, count) * 1e3, 3),
-            "p50_ms": round(float(p50), 3),
-            "p95_ms": round(float(p95), 3),
-            "p99_ms": round(float(p99), 3),
-            "max_ms": round(float(arr.max()), 3),
-        }
+        return histogram_summary(self.dump())
 
     def __repr__(self) -> str:
         return f"Histogram(count={self.count})"
@@ -260,48 +252,129 @@ class Registry:
             return sorted(self._metrics)
 
     # -- export ----------------------------------------------------------
+    def collect(self, scalars_only: bool = False
+                ) -> list[tuple[str, str, object]]:
+        """One atomic collection pass: ``[(name, kind, payload), ...]``
+        with every value read in a single tight sweep under the
+        registry lock — no formatting, parsing, or I/O between family
+        reads. Every renderer (``snapshot``, ``render_prometheus``,
+        ``dump``) formats FROM a collect() result, so a scrape landing
+        mid-update sees one point-in-time view instead of family A from
+        before an event and family B from after it (the old
+        render-while-reading hazard: a request completing mid-scrape
+        could bump ``serve_completed`` into the text while the
+        ``serve_e2e_latency`` family, rendered lines earlier, still
+        predated it). ``scalars_only`` skips histograms (and their
+        reservoir copies) — the flight recorder's delta notes run on
+        hot cadences and only track counters/gauges."""
+        out: list[tuple[str, str, object]] = []
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                if isinstance(m, Counter):
+                    out.append((name, "counter", m.value))
+                elif isinstance(m, Gauge):
+                    out.append((name, "gauge", m.value))
+                elif not scalars_only:
+                    out.append((name, "histogram", m.dump()))
+        return out
+
     def snapshot(self) -> dict:
         """One merged JSON-able view: counters -> int, gauges -> float,
-        histograms -> their ``summary()`` dict (ms)."""
-        with self._lock:
-            items = sorted(self._metrics.items())
+        histograms -> their ``summary()`` dict (ms). Rendered from one
+        :meth:`collect` pass."""
         out: dict = {}
-        for name, m in items:
-            if isinstance(m, Counter):
-                out[name] = m.value
-            elif isinstance(m, Gauge):
-                out[name] = m.value
+        for name, kind, payload in self.collect():
+            if kind == "histogram":
+                out[name] = histogram_summary(payload)
             else:
-                out[name] = m.summary()
+                out[name] = payload
+        return out
+
+    def dump(self) -> dict:
+        """Typed raw registry view for cross-process federation
+        (``obs/distributed.py``): counters/gauges with kind tags,
+        histograms with their full reservoir (see
+        :meth:`Histogram.dump`). One atomic :meth:`collect` pass."""
+        out: dict = {}
+        for name, kind, payload in self.collect():
+            if kind == "histogram":
+                out[name] = payload  # already typed by Histogram.dump
+            else:
+                out[name] = {"type": kind, "value": payload}
         return out
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (format version 0.0.4): counters
         as ``<name>_total``, gauges verbatim, histograms as summaries
         (p50/p95/p99 quantile samples in seconds + ``_sum``/``_count``).
-        """
-        with self._lock:
-            items = sorted(self._metrics.items())
+        Formats from one atomic :meth:`collect` pass, so families in
+        one scrape never mix epochs."""
         lines: list[str] = []
-        for name, m in items:
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {name}_total counter")
-                lines.append(f"{name}_total {m.value}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {_fmt(m.value)}")
-            else:
-                ex = m.export()  # coherent (count, sum, quantiles)
-                lines.append(f"# TYPE {name} summary")
-                for q, v in ex["quantiles"].items():
-                    lines.append(f'{name}{{quantile="{q:g}"}} {_fmt(v)}')
-                lines.append(f"{name}_sum {_fmt(ex['sum'])}")
-                lines.append(f"{name}_count {ex['count']}")
+        for name, payload in self.dump().items():
+            lines.extend(render_family(name, payload))
         return "\n".join(lines) + "\n"
+
+
+def histogram_export(dump: dict, qs=(0.5, 0.95, 0.99)) -> dict:
+    """Seconds-unit (count, sum, quantiles) from a histogram dump —
+    the pure half of :meth:`Histogram.export`, reusable on merged
+    (federated) reservoirs."""
+    samples = dump.get("samples") or []
+    if samples:
+        arr = np.asarray(samples, np.float64)
+        vals = np.percentile(arr, [q * 100.0 for q in qs])
+        quant = {q: float(v) for q, v in zip(qs, vals)}
+    else:
+        quant = {q: 0.0 for q in qs}
+    return {"count": dump.get("count", 0),
+            "sum": dump.get("total", 0.0), "quantiles": quant}
+
+
+def histogram_summary(dump: dict) -> dict:
+    """Milliseconds-unit summary (the ``/stats`` shape) from a
+    histogram dump — the pure half of :meth:`Histogram.summary`."""
+    samples = dump.get("samples") or []
+    count = dump.get("count", 0)
+    total = dump.get("total", 0.0)
+    if not samples:
+        return {"count": count, "mean_ms": 0.0, "p50_ms": 0.0,
+                "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+    arr = np.asarray(samples, np.float64) * 1e3
+    p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+    return {
+        "count": count,
+        "mean_ms": round(total / max(1, count) * 1e3, 3),
+        "p50_ms": round(float(p50), 3),
+        "p95_ms": round(float(p95), 3),
+        "p99_ms": round(float(p99), 3),
+        "max_ms": round(float(arr.max()), 3),
+    }
 
 
 def _fmt(v: float) -> str:
     return f"{v:.9g}"
+
+
+def render_family(name: str, payload: dict) -> list[str]:
+    """Exposition lines for ONE unlabelled metric family from its
+    typed :meth:`Registry.dump` payload — the single definition of the
+    counter/gauge/histogram-summary text format, shared by
+    :meth:`Registry.render_prometheus` and the federated renderer
+    (``obs/distributed.render_federated``) so the two surfaces can
+    never drift apart."""
+    t = payload.get("type")
+    if t == "counter":
+        return [f"# TYPE {name}_total counter",
+                f"{name}_total {int(payload['value'])}"]
+    if t == "gauge":
+        return [f"# TYPE {name} gauge", f"{name} {_fmt(payload['value'])}"]
+    ex = histogram_export(payload)
+    lines = [f"# TYPE {name} summary"]
+    for q, v in ex["quantiles"].items():
+        lines.append(f'{name}{{quantile="{q:g}"}} {_fmt(v)}')
+    lines.append(f"{name}_sum {_fmt(ex['sum'])}")
+    lines.append(f"{name}_count {ex['count']}")
+    return lines
 
 
 _DEFAULT = Registry()
@@ -315,26 +388,37 @@ def default_registry() -> Registry:
 
 
 def start_exposition_server(port: int, registry: Registry | None = None,
-                            host: str = "0.0.0.0"):
+                            host: str = "0.0.0.0", render_fn=None):
     """Minimal standalone Prometheus scrape surface: a daemon-threaded
     stdlib HTTP server answering ``GET /metrics`` with
-    :meth:`Registry.render_prometheus` (plus ``/healthz``). Exists for
-    processes that are NOT already serving HTTP — the multi-host
-    training supervisor (``train_dist.py --supervise --metrics-port``)
-    most of all; ``serve.py`` keeps its own integrated endpoint.
+    :meth:`Registry.render_prometheus` (plus ``/healthz``, plus
+    ``GET /metrics.json`` — the typed :meth:`Registry.dump` the
+    federation layer scrapes). Exists for processes that are NOT
+    already serving HTTP — the multi-host training supervisor
+    (``train_dist.py --supervise --metrics-port``) most of all;
+    ``serve.py`` keeps its own integrated endpoint.
 
-    Returns ``(server, actual_port)``; call ``server.shutdown()`` to
-    stop. ``port=0`` binds an ephemeral port (tests)."""
+    ``render_fn`` overrides the ``/metrics`` text (the cluster
+    supervisor passes its federated renderer so one scrape describes
+    the whole fleet); ``/metrics.json`` always dumps the local
+    registry. Returns ``(server, actual_port)``; call
+    ``server.shutdown()`` to stop. ``port=0`` binds an ephemeral port
+    (tests)."""
     import http.server
+    import json as _json
     import threading
 
     reg = registry if registry is not None else default_registry()
+    render = render_fn if render_fn is not None else reg.render_prometheus
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API name)
             if self.path.split("?")[0] == "/metrics":
-                body = reg.render_prometheus().encode()
+                body = render().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/metrics.json":
+                body = _json.dumps(reg.dump()).encode()
+                ctype = "application/json"
             elif self.path.split("?")[0] == "/healthz":
                 body, ctype = b"ok\n", "text/plain"
             else:

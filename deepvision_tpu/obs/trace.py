@@ -32,7 +32,28 @@ Design points:
   inserts ``jax.block_until_ready`` before the end timestamp;
 - **ring buffer**: the most recent ``capacity`` spans are kept (bounded
   memory on long runs); export writes Chrome trace format JSON that
-  loads directly in ``chrome://tracing`` and Perfetto.
+  loads directly in ``chrome://tracing`` and Perfetto. Overflow is
+  never silent: evicted spans are counted (``dropped_spans``, the
+  ``trace_dropped_spans`` obs counter) and the export carries the
+  count in its metadata, so a truncated trace can't masquerade as a
+  complete one;
+- **sinks**: ``add_sink(fn)`` registers a per-span callback (the
+  distributed spool writer and the flight recorder,
+  ``obs/distributed.py``). Spans record whenever the tracer is enabled
+  OR a sink is attached, so an always-on flight recorder doesn't
+  require the in-memory ring/export machinery to be on;
+- **retroactive spans**: :meth:`Tracer.record_span` records a span
+  from explicit ``perf_counter`` stamps — for code that already times
+  a region with its own clock reads (the serve engine's per-request
+  queue-wait, measured as ``t_dispatch - t_submit``) and wants the
+  interval on the trace without restructuring into a ``with`` block;
+- **wall-clock calibration**: ``epoch_wall`` records the wall time of
+  the monotonic trace zero, so a cross-process merger
+  (``tools/trace_merge.py``) can align rings/spools from many
+  processes onto one timeline;
+- **process labels**: ``set_labels(role=..., host=..., generation=...)``
+  stamps exports and spool headers so a merged fleet/cluster trace
+  names its pid rows (``replica r1``, ``host 0 gen 2``).
 
 :func:`summarize_chrome` turns an exported trace back into per-span
 totals + a wall-time-attribution figure; ``tools/trace_summary.py`` is
@@ -48,7 +69,8 @@ import time
 from collections import deque
 from pathlib import Path
 
-__all__ = ["Span", "Tracer", "get_tracer", "span", "summarize_chrome"]
+__all__ = ["Span", "Tracer", "format_labels", "get_tracer", "span",
+           "summarize_chrome"]
 
 
 class _NoopSpan:
@@ -115,14 +137,35 @@ class Tracer:
     def __init__(self, capacity: int = 65536):
         self._lock = threading.Lock()
         self._events: deque[tuple] = deque(maxlen=capacity)
+        self._capacity = capacity
         self._enabled = False
         self._epoch = time.perf_counter()  # trace time zero
+        self.epoch_wall = time.time()      # wall clock of that zero
         self._local = threading.local()
+        self._sinks: list = []
+        self._dropped = 0          # ring evictions since clear()
+        self._drop_counter = None  # lazily bound obs counter
+        self._labels: dict = {}
 
     # -- lifecycle -------------------------------------------------------
     @property
     def enabled(self) -> bool:
         return self._enabled
+
+    @property
+    def active(self) -> bool:
+        """Spans record when the ring is enabled OR a sink is attached
+        (a spool/flight-recorder sink keeps spans flowing without the
+        in-memory export machinery)."""
+        return self._enabled or bool(self._sinks)
+
+    @property
+    def dropped_spans(self) -> int:
+        """Spans evicted from the ring since the last :meth:`clear` —
+        the count the export metadata reports so truncation is never
+        silent."""
+        with self._lock:
+            return self._dropped
 
     def enable(self, clear: bool = True) -> "Tracer":
         if clear:
@@ -136,13 +179,39 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._dropped = 0
             self._epoch = time.perf_counter()
+            self.epoch_wall = time.time()
+
+    def add_sink(self, fn) -> None:
+        """Register ``fn(record: dict)`` called (under the tracer lock,
+        in recording order) for every completed span. Keep sinks cheap:
+        they run on the recording thread."""
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+    def set_labels(self, **labels) -> None:
+        """Stamp process identity (``role`` / ``host`` / ``generation``)
+        onto exports and spool headers; a cross-process merge uses them
+        to name this process's pid row."""
+        self._labels.update({k: v for k, v in labels.items()
+                             if v is not None})
+
+    @property
+    def labels(self) -> dict:
+        return dict(self._labels)
 
     # -- recording -------------------------------------------------------
     def span(self, name: str, cat: str = "app", args: dict | None = None,
              device_sync=None):
-        """Context manager timing its body; no-op while disabled."""
-        if not self._enabled:
+        """Context manager timing its body; no-op while inactive."""
+        if not self.active:
             return _NOOP
         return Span(self, name, cat, args, device_sync)
 
@@ -154,14 +223,60 @@ class Tracer:
         self._local.depth = depth
         return depth  # 0 for outermost spans
 
+    def record_span(self, name: str, t0: float, t1: float,
+                    cat: str = "app", args: dict | None = None) -> None:
+        """Retroactively record a completed span from explicit
+        ``time.perf_counter()`` stamps (same clock as live spans).
+        Used where the timing already exists as stamps — the serve
+        engine's per-request queue-wait/device/postprocess intervals —
+        so the trace carries them without a ``with`` rewrite."""
+        if not self.active:
+            return
+        self._emit(name, cat, t0, max(0.0, t1 - t0), 0, args)
+
     def _record(self, name: str, cat: str, t0: float, dur: float,
                 depth: int, args: dict | None) -> None:
-        if not self._enabled:
-            return  # disabled while the span was open: drop it
+        if not self.active:
+            return  # deactivated while the span was open: drop it
+        self._emit(name, cat, t0, dur, depth, args)
+
+    def _emit(self, name: str, cat: str, t0: float, dur: float,
+              depth: int, args: dict | None) -> None:
         thread = threading.current_thread()
+        event = (name, cat, t0 - self._epoch, dur,
+                 thread.ident, thread.name, depth, args)
         with self._lock:
-            self._events.append((name, cat, t0 - self._epoch, dur,
-                                 thread.ident, thread.name, depth, args))
+            if self._enabled:
+                if len(self._events) >= self._capacity:
+                    # the deque evicts silently; the count keeps the
+                    # truncation honest ("no silent caps")
+                    self._dropped += 1
+                    self._inc_drop_counter()
+                self._events.append(event)
+            if self._sinks:
+                rec = self._sink_record(event)
+                for sink in self._sinks:
+                    try:
+                        sink(rec)
+                    except Exception:
+                        pass  # a broken sink must never fail the loop
+
+    @staticmethod
+    def _sink_record(event: tuple) -> dict:
+        name, cat, ts, dur, tid, tname, depth, args = event
+        rec = {"name": name, "cat": cat, "ts": ts, "dur": dur,
+               "tid": tid, "tname": tname, "depth": depth}
+        if args:
+            rec["args"] = args
+        return rec
+
+    def _inc_drop_counter(self) -> None:
+        if self._drop_counter is None:
+            from deepvision_tpu.obs.metrics import default_registry
+
+            self._drop_counter = default_registry().counter(
+                "trace_dropped_spans")
+        self._drop_counter.inc()
 
     def __len__(self) -> int:
         with self._lock:
@@ -187,17 +302,52 @@ class Tracer:
         for tid, tname in threads.items():
             out.append({"ph": "M", "name": "thread_name", "pid": pid,
                         "tid": tid, "args": {"name": tname}})
+        if self._labels:
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "tid": 0, "args": {"name": format_labels(
+                            self._labels)}})
         return out
 
     def export(self, path: str | Path) -> int:
         """Write ``{"traceEvents": [...]}`` (loads in chrome://tracing
-        and Perfetto); returns the number of span events written."""
+        and Perfetto); returns the number of span events written. The
+        ``metadata`` block carries ``trace_dropped_spans`` — how many
+        spans the ring evicted since the last clear — so a truncated
+        trace is labelled as such instead of silently passing for the
+        whole story."""
         events = self.chrome_events()
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
+        meta = {"trace_dropped_spans": self.dropped_spans,
+                "complete": self.dropped_spans == 0,
+                "pid": os.getpid(), "epoch_wall": self.epoch_wall}
+        if self._labels:
+            meta["labels"] = dict(self._labels)
         path.write_text(json.dumps(
-            {"traceEvents": events, "displayTimeUnit": "ms"}))
+            {"traceEvents": events, "displayTimeUnit": "ms",
+             "metadata": meta}))
         return sum(1 for e in events if e.get("ph") == "X")
+
+
+def format_labels(labels: dict) -> str:
+    """Human row name for a labelled process: ``role`` first, then the
+    cluster identity — ``"replica r1"``, ``"host 0 gen 2"``."""
+    parts = []
+    role = labels.get("role")
+    if role:
+        parts.append(str(role))
+    host = labels.get("host")
+    if host is not None and (not role or str(role) != f"host{host}"):
+        parts.append(f"host {host}")
+    gen = labels.get("generation")
+    if gen is not None:
+        g = str(gen)
+        parts.append(g if g.startswith(("gen", "replay"))
+                     else f"gen {g}")
+    for k in sorted(labels):
+        if k not in ("role", "host", "generation"):
+            parts.append(f"{k}={labels[k]}")
+    return " ".join(parts) or "process"
 
 
 _TRACER = Tracer()

@@ -64,6 +64,7 @@ import threading
 import time
 from pathlib import Path
 
+from deepvision_tpu.obs.distributed import flight_dump, get_flight_recorder
 from deepvision_tpu.obs.metrics import default_registry
 
 __all__ = [
@@ -111,6 +112,10 @@ ENV_TIMEOUT = "DVTPU_CLUSTER_BARRIER_TIMEOUT"
 # (generation indices are not), so ':hostH'-targeted sdc drills and the
 # quarantine ledger name the same physical host forever
 ENV_ORIG_HOST = "DVTPU_CLUSTER_ORIG_HOST"
+# the generation index, exported so every worker's tracer stamps its
+# spans (host, generation) — one training step is correlatable across
+# hosts and relaunches on the merged fleet timeline
+ENV_GEN = "DVTPU_CLUSTER_GEN"
 # replay-bisection mode: train deterministically to this RUN step
 # (auditing on the way), then exit 0 without saving — the audit files
 # are the replay's verdict (resilience/sentinel.py module docstring)
@@ -163,7 +168,9 @@ class ClusterMember:
     def __init__(self, directory: str | Path, host: int, nhosts: int, *,
                  barrier_lead: int = BARRIER_LEAD,
                  barrier_timeout_s: float = 30.0,
-                 beat_interval_s: float = 0.2):
+                 beat_interval_s: float = 0.2,
+                 orig_host: int | None = None,
+                 metrics_interval_s: float = 2.0):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.host = int(host)
@@ -171,29 +178,63 @@ class ClusterMember:
         if not 0 <= self.host < self.nhosts:
             raise ValueError(
                 f"host {host} outside the fleet of {nhosts}")
+        # the stable physical identity (generation indices reshuffle on
+        # elastic resume): metric labels and spool rows carry this one
+        self.orig_host = int(orig_host) if orig_host is not None \
+            else self.host
         self.barrier_lead = int(barrier_lead)
         self.barrier_timeout_s = float(barrier_timeout_s)
         self.beat_interval_s = float(beat_interval_s)
+        self.metrics_interval_s = float(metrics_interval_s)
         self._last_beat = 0.0
+        self._last_metrics = 0.0
         self._last_epoch = -1
         self._barrier_cache: dict | None = None
         self._own_audits: dict[int, dict] = {}
         self._audits_compared: set[int] = set()
+        self._spool = None
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "ClusterMember | None":
         """The launcher->worker wiring: ``train_dist.py --supervise``
         exports the coordination dir + identity; ``train.py`` attaches
-        the member to the Trainer when present."""
+        the member to the Trainer when present. The worker side of the
+        fleet observability attaches here too: tracer labels, span
+        spool, flight recorder."""
         d = environ.get(ENV_DIR)
         if not d:
             return None
-        return cls(
-            d, int(environ.get(ENV_HOST, "0")),
+        host = int(environ.get(ENV_HOST, "0"))
+        member = cls(
+            d, host,
             int(environ.get(ENV_NHOSTS, "1")),
             barrier_lead=int(environ.get(ENV_LEAD, str(BARRIER_LEAD))),
             barrier_timeout_s=float(environ.get(ENV_TIMEOUT, "30")),
+            orig_host=int(environ.get(ENV_ORIG_HOST, str(host))),
         )
+        member.attach_observability(environ)
+        return member
+
+    def attach_observability(self, environ=os.environ) -> None:
+        """Fleet-wide observability, worker side (obs/distributed.py):
+        stamp the tracer with (host, generation), attach the span spool
+        the supervisor requested via ``DVTPU_TRACE_SPOOL`` (the
+        crash-safe on-disk ring that survives even a SIGKILL — the
+        quarantine black box), and install the flight recorder dumping
+        into the coordination dir on trip/divergence/preempt."""
+        try:
+            from deepvision_tpu.obs.distributed import (
+                enable_spool_from_env,
+                install_flight_recorder,
+            )
+
+            self._spool = enable_spool_from_env(
+                role=f"host{self.orig_host}", environ=environ)
+            install_flight_recorder(
+                self.directory,
+                meta={"role": "trainer", "host": self.orig_host})
+        except Exception:
+            pass  # observability must never take a worker down
 
     # -- liveness --------------------------------------------------------
     def beat(self, step: int, epoch: int | None = None,
@@ -211,12 +252,36 @@ class ClusterMember:
             self.directory / f"hb-{self.host}.json",
             {"host": self.host, "pid": os.getpid(), "step": int(step),
              "epoch": int(epoch), "status": status, "time": now})
+        if now - self._last_metrics >= self.metrics_interval_s:
+            self._last_metrics = now
+            self.publish_metrics(step, now=now)
+
+    def publish_metrics(self, step: int, now: float | None = None) -> None:
+        """Federated-metrics publication, riding the heartbeat cadence:
+        an atomic typed registry dump (``metrics-<index>.json``) the
+        supervisor scrapes into its ``--metrics-port`` surface with
+        ``{host=<orig>}`` labels, plus a flight-recorder note so the
+        black box carries per-interval metric deltas keyed by step."""
+        try:
+            _atomic_write_json(
+                self.directory / f"metrics-{self.host}.json",
+                {"host": self.orig_host, "index": self.host,
+                 "time": now if now is not None else time.time(),
+                 "dump": default_registry().dump()})
+            rec = get_flight_recorder()
+            if rec is not None:
+                rec.note("beat", step=int(step))
+        except Exception:
+            pass  # the scrape surface must never take the worker down
 
     # -- save-barrier protocol -------------------------------------------
     def write_barrier(self, epoch: int, stop_step: int) -> dict:
         """Publish the cluster-wide stop point (first writer wins —
         concurrent notices collapse to one barrier); returns the
-        winning marker."""
+        winning marker. The notice holder dumps its flight recorder —
+        this host is leaving (SIGTERM), so its black box goes to disk
+        while it still can."""
+        flight_dump("sigterm-preempt")
         _create_once_json(
             self.directory / "barrier.json",
             {"epoch": int(epoch), "stop_step": int(stop_step),
@@ -227,6 +292,7 @@ class ClusterMember:
         """Exit-after-epoch marker for notices that land outside the
         step loop (validate/save): peers at the same boundary exit
         after their epoch checkpoint; peers already past it degrade."""
+        flight_dump("sigterm-preempt")
         _create_once_json(
             self.directory / "barrier.json",
             {"after_epoch": int(epoch), "by": self.host})
@@ -262,7 +328,9 @@ class ClusterMember:
     def mark_committed(self, epoch: int, step: int) -> None:
         """Record that THIS host's coordinated save committed; the
         supervisor requires all-hosts markers with one common step to
-        call the preemption save trustworthy."""
+        call the preemption save trustworthy. Every host exits after
+        this — the black box of its final window rides along."""
+        flight_dump("preempt-save")
         _atomic_write_json(
             self.directory / f"commit-{self.host}.json",
             {"host": self.host, "epoch": int(epoch), "step": int(step)})
@@ -347,7 +415,11 @@ class ClusterMember:
     def write_divergence(self, div: dict) -> None:
         """First-writer-wins divergence marker — the supervisor's
         signal that this generation ended in an SDC, with the per-host
-        fingerprints attribution starts from."""
+        fingerprints attribution starts from. The black box dumps
+        FIRST: the supervisor tears the generation down (SIGKILL) the
+        moment it sees the marker, so the last-K-steps record must hit
+        disk before the marker does."""
+        flight_dump("sdc-divergence")
         _create_once_json(self.directory / "sdc-divergence.json",
                           {"by": self.host, **div,
                            "fps": {str(h): fp
@@ -356,7 +428,9 @@ class ClusterMember:
     def write_trip(self, step: int, key: str, value: float,
                    z: float) -> None:
         """Self-identified sentinel trip marker: the host caught its
-        OWN state misbehaving, so attribution needs no bisection."""
+        OWN state misbehaving, so attribution needs no bisection. Black
+        box first, marker second (the marker triggers teardown)."""
+        flight_dump("sentinel-trip")
         _atomic_write_json(
             self.directory / f"sdc-trip-{self.host}.json",
             {"host": self.host, "step": int(step), "key": key,
@@ -477,6 +551,9 @@ class ClusterSupervisor:
         self._scanned_dirs: set[Path] = set()
         self.cluster_root = self.workdir / "cluster"
         self.excluded_ledger = self.workdir / "excluded_hosts.json"
+        # the live generation's coordination dir — where the federated
+        # /metrics scrape finds the members' metrics-<index>.json dumps
+        self._live_dir: Path | None = None
 
     # -- worker launching ------------------------------------------------
     def _default_worker_cmd(self, ctx: dict) -> list[str]:
@@ -508,6 +585,12 @@ class ClusterSupervisor:
                    ENV_ORIG_HOST: str(host),
                    ENV_LEAD: str(self.barrier_lead),
                    ENV_TIMEOUT: str(self.barrier_timeout_s),
+                   # fleet observability: workers stamp spans with
+                   # (host, generation) and spool them into the gen dir
+                   # — the crash-safe on-disk ring that survives even a
+                   # SIGKILL, and the raw material of trace_merge
+                   ENV_GEN: gen_dir.name,
+                   "DVTPU_TRACE_SPOOL": str(gen_dir),
                    **(extra_env or {})}
             p = subprocess.Popen(
                 self._worker_cmd(ctx), env=env,
@@ -568,6 +651,7 @@ class ClusterSupervisor:
                         resume: bool) -> tuple[str, set]:
         gen_dir = self.cluster_root / f"gen-{gen:03d}"
         gen_dir.mkdir(parents=True, exist_ok=True)
+        self._live_dir = gen_dir
         self.log(f"[cluster] gen {gen}: launching hosts {hosts} "
                  f"(resume={resume})", flush=True)
         procs = self._spawn(gen_dir, hosts, resume)
@@ -702,7 +786,72 @@ class ClusterSupervisor:
                 return True
         return False
 
+    # -- federated metrics (obs/distributed.py) --------------------------
+    def render_federated_metrics(self) -> str:
+        """The ``--metrics-port`` text: the supervisor's own registry
+        (cluster_*/sentinel_* counters and liveness gauges) plus every
+        live host's registry dump — published on the heartbeat cadence
+        as ``metrics-<index>.json`` in the generation dir — labelled
+        ``{host="<orig id>"}`` with exact counter sums, so one scrape
+        of the supervisor describes the whole training fleet."""
+        from deepvision_tpu.obs.distributed import render_federated
+
+        children: dict[str, dict] = {}
+        d = self._live_dir
+        if d is not None and d.exists():
+            for f in sorted(d.glob("metrics-*.json")):
+                rec = _read_json(f)
+                if rec and isinstance(rec.get("dump"), dict):
+                    children[str(rec.get("host", f.stem.split("-")[-1]))] \
+                        = rec["dump"]
+        return render_federated(children, own=self._registry,
+                                label="host", own_label="supervisor")
+
     # -- SDC attribution: replay bisection + quarantine ------------------
+    def _extract_black_box(self, gen_dir: Path, host: int) -> Path | None:
+        """A SIGKILLed culprit ran no dump handler — its crash-safe
+        span spool tail and last published metrics dump ARE the black
+        box. Extract them into a flight-recorder-format file in the
+        workdir, so every quarantine verdict ships with the culprit's
+        last K steps (``tools/trace_merge.py`` renders it like any
+        other dump)."""
+        from deepvision_tpu.obs.distributed import read_spool, spool_paths
+
+        try:
+            events: list[dict] = []
+            for p in spool_paths(gen_dir):
+                if f"-host{host}-" in p.name:
+                    events.extend(read_spool(p)["events"])
+            events.sort(key=lambda e: e.get("wall", 0.0))
+            tail = events[-512:]
+            for e in tail:
+                # spool events carry calibrated wall stamps; rebase the
+                # dump on epoch_wall=0 so wall == ts for the merger
+                e["ts"] = e.pop("wall", e.get("ts", 0.0))
+                e.setdefault("kind", "span")
+            metrics = None
+            for f in gen_dir.glob("metrics-*.json"):
+                rec = _read_json(f)
+                if rec and rec.get("host") == host:
+                    metrics = rec
+            out = self.workdir / f"flightrec-host{host}-quarantine.json"
+            _atomic_write_json(out, {
+                "flightrec": 1, "reason": "quarantine",
+                "time": time.time(), "pid": None,
+                "labels": {"host": host, "role": f"host{host}"},
+                "epoch_wall": 0.0,
+                "events": tail,
+                "snapshot": (metrics or {}).get("dump"),
+            })
+            self.log(f"[sentinel] black box for quarantined host {host} "
+                     f"({len(tail)} events from its spool): {out}",
+                     flush=True)
+            return out
+        except Exception as e:
+            self.log(f"[sentinel] black-box extraction for host {host} "
+                     f"failed: {type(e).__name__}: {e}", flush=True)
+            return None
+
     def _scan_sentinel(self, d: Path) -> None:
         """Fold one generation/replay dir's sentinel artifacts into the
         counters (idempotent per directory)."""
@@ -830,7 +979,7 @@ class ClusterSupervisor:
             and rec["host"] < len(hosts))
         if tripped:
             self._exclude(tripped, reason="self-identified sentinel "
-                          "trip", replays=0)
+                          "trip", replays=0, gen_dir=gen_dir)
             return tripped
         div = _read_json(gen_dir / "sdc-divergence.json")
         if div is None:
@@ -845,7 +994,8 @@ class ClusterSupervisor:
         if len(majority) * 2 > len(fps):
             culprits = sorted(h for h in fps if h not in majority)
             self._exclude(culprits, reason=f"fingerprint minority at "
-                          f"audit step {step}", replays=0, step=step)
+                          f"audit step {step}", replays=0, step=step,
+                          gen_dir=gen_dir)
             return culprits
         # no majority (e.g. a 2-host fleet): replay bisection. A probe
         # that stays internally consistent yields the ground-truth
@@ -880,7 +1030,8 @@ class ClusterSupervisor:
                 if culprits:
                     self._exclude(culprits, reason="fingerprint "
                                   "mismatch vs replayed ground truth",
-                                  replays=replays, step=step)
+                                  replays=replays, step=step,
+                                  gen_dir=gen_dir)
                     return culprits
                 self.log("[sentinel] replay matched every original "
                          "fingerprint — divergence did not reproduce; "
@@ -892,7 +1043,7 @@ class ClusterSupervisor:
             suspects = half
         if len(suspects) == 1:
             self._exclude(suspects, reason="replay bisection",
-                          replays=replays, step=step)
+                          replays=replays, step=step, gen_dir=gen_dir)
             return suspects
         self.log(f"[sentinel] attribution ambiguous after {replays} "
                  f"replays (suspects {suspects}); NOT quarantining "
@@ -900,8 +1051,12 @@ class ClusterSupervisor:
         return []
 
     def _exclude(self, culprits: list[int], *, reason: str,
-                 replays: int, step: int | None = None) -> None:
+                 replays: int, step: int | None = None,
+                 gen_dir: Path | None = None) -> None:
         ledger = _read_json(self.excluded_ledger) or {"excluded": []}
+        if gen_dir is not None:
+            for h in culprits:
+                self._extract_black_box(gen_dir, h)
         for h in culprits:
             ledger["excluded"].append(
                 {"host": int(h), "reason": reason,

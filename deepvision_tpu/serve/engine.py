@@ -53,6 +53,8 @@ from typing import Iterable
 
 import numpy as np
 
+from deepvision_tpu.obs.distributed import flight_dump
+from deepvision_tpu.obs.trace import get_tracer
 from deepvision_tpu.serve.admission import AdmissionController, ShedError
 from deepvision_tpu.serve.compile_cache import CompileCache
 from deepvision_tpu.serve.models import ServedModel
@@ -64,14 +66,19 @@ _WAKE = object()  # queue sentinel: wake the dispatcher without a request
 
 
 class _Request:
-    __slots__ = ("model", "x", "future", "t_submit", "deadline")
+    __slots__ = ("model", "x", "future", "t_submit", "deadline", "trace")
 
-    def __init__(self, model: str, x, deadline: float | None):
+    def __init__(self, model: str, x, deadline: float | None,
+                 trace: str | None = None):
         self.model = model
         self.x = x
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline
+        # distributed trace id (obs/distributed.py): stamped on the
+        # replica-side queue/device/postprocess spans so one request's
+        # timeline assembles across the router and replica processes
+        self.trace = trace
 
 
 class InferenceEngine:
@@ -215,12 +222,15 @@ class InferenceEngine:
 
     # -- client surface --------------------------------------------------
     def submit(self, x, model: str | None = None, *,
-               timeout_s: float | None = None) -> Future:
+               timeout_s: float | None = None,
+               trace: str | None = None) -> Future:
         """Enqueue one example (no batch dim) for ``model``; returns a
         Future resolving to the task's result dict. Raises
         :class:`ShedError` immediately when admission rejects, and
         ``ValueError`` on shape/model mismatch (fail fast, not in the
-        dispatcher)."""
+        dispatcher). ``trace`` is the request's distributed trace id
+        (propagated from the router over ``X-DVTPU-Trace``): the
+        per-request queue/device/postprocess spans carry it."""
         if model is None:
             if len(self._models) != 1:
                 raise ValueError(
@@ -246,7 +256,8 @@ class InferenceEngine:
         req = _Request(
             model, x,
             deadline=(time.perf_counter() + timeout_s
-                      if timeout_s is not None else None))
+                      if timeout_s is not None else None),
+            trace=trace)
         self._q.put(req)
         if self._stop.is_set():
             # raced close(): the dispatcher's exit drain may already
@@ -322,6 +333,9 @@ class InferenceEngine:
                 return  # clean close(): loop drained and exited
             except BaseException as e:
                 self.telemetry.record_dispatcher_crash()
+                # black box first: the flight recorder's ring holds the
+                # spans/metric deltas leading up to exactly this moment
+                flight_dump("dispatcher_crash")
                 n = self._fail_all_pending(RuntimeError(
                     f"dispatcher crashed: {type(e).__name__}: {e}"))
                 print(f"[serve-supervisor] dispatcher crashed "
@@ -504,8 +518,28 @@ class InferenceEngine:
             return
         self.telemetry.record_batch(bucket=bucket, rows=n, device_s=t_dev)
         self._admission.observe_batch(t_dev, n)
+        tracer = get_tracer()
+        if tracer.active:
+            # retroactive spans from the stamps this loop already takes
+            # (obs/trace.py record_span — same perf_counter clock): the
+            # replica half of the distributed request timeline. The
+            # device span already measured completed compute —
+            # device_get above drained the dispatch, the JX112/JX117
+            # contract
+            traces = [r.trace for r in reqs if r.trace]
+            tracer.record_span(
+                "device", t0, t0 + t_dev, cat="serve",
+                args={"model": served.name, "bucket": bucket, "rows": n,
+                      **({"traces": traces} if traces else {})})
+            for r in reqs:
+                if r.trace:
+                    tracer.record_span(
+                        "replica_queue", r.t_submit, t_dispatch,
+                        cat="serve",
+                        args={"trace": r.trace, "model": served.name})
         now = time.perf_counter()
         for i, r in enumerate(reqs):
+            t_pp = time.perf_counter()
             try:
                 result = served.postprocess(host, i)
             except Exception as e:
@@ -516,6 +550,10 @@ class InferenceEngine:
                 self.telemetry.record_request(
                     queue_wait_s=t_dispatch - r.t_submit,
                     e2e_s=now - r.t_submit)
+            if r.trace and tracer.active:
+                tracer.record_span(
+                    "postprocess", t_pp, time.perf_counter(),
+                    cat="serve", args={"trace": r.trace})
             self._admission.release(r.model)
 
     def _resolve_dropped(self, r: _Request) -> None:

@@ -97,11 +97,13 @@ class EngineReplica:
 
     # -- serving surface -------------------------------------------------
     def request(self, model: str | None, x, *,
-                timeout_s: float | None = None) -> dict:
+                timeout_s: float | None = None,
+                trace: str | None = None) -> dict:
         if self._dead or self._engine is None:
             raise ReplicaDeadError(f"{self.replica_id}: replica is dead")
         try:
-            fut = self._engine.submit(x, model=model, timeout_s=timeout_s)
+            fut = self._engine.submit(x, model=model, timeout_s=timeout_s,
+                                      trace=trace)
             return fut.result(
                 timeout=timeout_s + 1.0 if timeout_s is not None else None)
         except (ShedError, TimeoutError, ValueError):
@@ -126,6 +128,14 @@ class EngineReplica:
         if self._dead or self._engine is None:
             raise ReplicaDeadError(f"{self.replica_id}: replica is dead")
         return self._engine.stats()
+
+    def metrics_dump(self) -> dict:
+        """This replica's typed registry dump (histogram reservoirs
+        included) — the federation scrape, straight off the engine's
+        private registry."""
+        if self._dead or self._engine is None:
+            raise ReplicaDeadError(f"{self.replica_id}: replica is dead")
+        return self._engine.telemetry.registry.dump()
 
 
 class ProcessReplica:
@@ -229,7 +239,7 @@ class ProcessReplica:
 
     # -- HTTP plumbing ---------------------------------------------------
     def _http(self, method: str, path: str, body: str | None = None,
-              timeout_s: float = 10.0):
+              timeout_s: float = 10.0, headers: dict | None = None):
         import http.client
 
         if self._dead or self._port is None:
@@ -248,7 +258,7 @@ class ProcessReplica:
             if conn.sock is not None:
                 conn.sock.settimeout(timeout_s)
         try:
-            conn.request(method, path, body)
+            conn.request(method, path, body, headers=headers or {})
             resp = conn.getresponse()
             return resp.status, dict(resp.getheaders()), resp.read()
         except TimeoutError as e:
@@ -288,7 +298,8 @@ class ProcessReplica:
 
     # -- serving surface -------------------------------------------------
     def request(self, model: str | None, x, *,
-                timeout_s: float | None = None) -> dict:
+                timeout_s: float | None = None,
+                trace: str | None = None) -> dict:
         import base64
 
         # binary wire format (serve.py `input_b64`): base64 raw bytes
@@ -309,9 +320,17 @@ class ProcessReplica:
             # losing attempt still burns a full replica slot under the
             # child's blanket --timeout-s
             payload["timeout_s"] = round(timeout_s, 3)
+        req_headers = None
+        if trace is not None:
+            # the distributed-trace hop: the child stamps its
+            # queue/device/postprocess spans with this id, so the
+            # merged fleet trace links router attempt -> replica work
+            from deepvision_tpu.obs.distributed import TRACE_HEADER
+
+            req_headers = {TRACE_HEADER: trace}
         status, headers, body = self._http(
             "POST", "/v1/predict", json.dumps(payload),
-            timeout_s=(timeout_s or 30.0) + 1.0)
+            timeout_s=(timeout_s or 30.0) + 1.0, headers=req_headers)
         try:
             data = json.loads(body)
         except ValueError:
@@ -349,6 +368,17 @@ class ProcessReplica:
         if status != 200:
             raise ReplicaDeadError(
                 f"{self.replica_id}: /stats HTTP {status}")
+        return json.loads(body)
+
+    def metrics_dump(self) -> dict:
+        """The child's typed registry dump over HTTP
+        (``GET /metrics.json``) — what the router federates into its
+        fleet-wide ``/metrics``."""
+        status, _h, body = self._http("GET", "/metrics.json",
+                                      timeout_s=5.0)
+        if status != 200:
+            raise RuntimeError(
+                f"{self.replica_id}: /metrics.json HTTP {status}")
         return json.loads(body)
 
 

@@ -54,6 +54,8 @@ from concurrent.futures import (
 from dataclasses import dataclass
 from typing import Callable
 
+from deepvision_tpu.obs.distributed import new_trace_id, render_federated
+from deepvision_tpu.obs.trace import span
 from deepvision_tpu.serve.admission import AdmissionController, ShedError
 from deepvision_tpu.serve.replica import ReplicaDeadError
 from deepvision_tpu.serve.telemetry import RouterTelemetry
@@ -264,16 +266,20 @@ class _Request:
     """One routed request: resolve-once future + routing context."""
 
     __slots__ = ("model", "key", "x", "future", "t_submit", "deadline",
-                 "_resolved", "_lock")
+                 "trace", "_resolved", "_lock")
 
     def __init__(self, model: str | None, x, deadline: float,
-                 key: str | None = None):
+                 key: str | None = None, trace: str | None = None):
         self.model = model
         self.key = key if key is not None else (model or "_default")
         self.x = x
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline
+        # distributed trace id: minted here (the fleet's front door)
+        # unless an upstream surface already assigned one; every
+        # attempt span and the replica-side spans carry it
+        self.trace = trace if trace is not None else new_trace_id()
         self._resolved = False
         self._lock = threading.Lock()
 
@@ -364,6 +370,7 @@ class FleetRouter:
         self._last_shed_totals = 0.0
         self._last_signal_t = time.monotonic()
         self._autoscale_due = time.monotonic()
+        self._flight_note_due = time.monotonic()
         self._respawn_not_before = 0.0
         # TWO pools: coordinators (one per in-flight request) and
         # replica attempts (<= 2 per RUNNING coordinator, so 2x workers
@@ -446,10 +453,14 @@ class FleetRouter:
         return (64,)
 
     def submit(self, x, model: str | None = None, *,
-               timeout_s: float | None = None) -> Future:
+               timeout_s: float | None = None,
+               trace: str | None = None) -> Future:
         """Route one example; returns a Future resolving to the task's
         result dict. Sheds raise immediately (circuit open / admission),
-        the same :class:`ShedError` contract as the engine."""
+        the same :class:`ShedError` contract as the engine. ``trace``
+        carries an upstream trace id; absent, the router mints one —
+        either way every replica attempt propagates it over the
+        ``X-DVTPU-Trace`` hop."""
         if self._stop.is_set():
             raise RuntimeError("router is closed")
         # anonymous requests on a single-model fleet resolve to that
@@ -478,7 +489,7 @@ class FleetRouter:
                   if b is not None]
         budget = min(bounds) if bounds else self._default_deadline_s
         req = _Request(model, x, deadline=time.monotonic() + budget,
-                       key=key)
+                       key=key, trace=trace)
         self._pool.submit(self._dispatch, req, breaker, key)
         return req.future
 
@@ -622,8 +633,18 @@ class FleetRouter:
             remaining = req.deadline - time.monotonic()
             if remaining <= 0:
                 return False, TimeoutError("deadline expired")
-            result = slot.replica.request(
-                req.model, req.x, timeout_s=remaining)
+            # the router half of the distributed request timeline: the
+            # span shares the request's trace id with the replica-side
+            # queue/device spans, so trace_merge can draw the flow
+            # router attempt -> replica execution (no-op unless the
+            # tracer is active)
+            with span("router_attempt", cat="router",
+                      args={"trace": req.trace, "replica": slot.sid,
+                            "model": req.key,
+                            **({"hedge": True} if hedge else {})}):
+                result = slot.replica.request(
+                    req.model, req.x, timeout_s=remaining,
+                    trace=req.trace)
         except ReplicaDeadError as e:
             breaker.record_failure()
             self._on_replica_dead(slot, str(e))
@@ -820,6 +841,16 @@ class FleetRouter:
         tel.queue_wait_p95_ms.set(queue_p95)
         tel.shed_rate_per_s.set(shed_rate)
         tel.dispatcher_crashes.set(crashes)
+        if now >= self._flight_note_due:
+            # the serving-side flight-recorder cadence: a counter-delta
+            # note every ~2s turns the crash black box into "what the
+            # router was doing, tick by tick, right before the end"
+            self._flight_note_due = now + 2.0
+            from deepvision_tpu.obs.distributed import get_flight_recorder
+
+            rec = get_flight_recorder()
+            if rec is not None:
+                rec.note("probe", replicas_ready=ready_n)
         if self._autoscaler is None or now < self._autoscale_due:
             return
         self._autoscale_due = now + self._autoscale_cfg.interval_s
@@ -854,6 +885,45 @@ class FleetRouter:
         tel.replicas_target.set(self._target)
 
     # -- introspection ---------------------------------------------------
+    def metrics_children(self) -> dict[str, dict]:
+        """Scrape every live replica's typed registry dump keyed by
+        slot id — the federation input. Children are scraped
+        CONCURRENTLY so one wedged replica costs the surface a single
+        scrape timeout, not one per wedged child — the fleet's metrics
+        must stay up precisely when replicas are misbehaving. A
+        replica that fails the scrape (mid-restart, mid-drain) is
+        skipped, not fatal: the fleet surface degrades to the
+        reachable children."""
+        with self._lock:
+            slots = [s for s in self._slots
+                     if s.state in (READY, DRAINING) and
+                     s.replica is not None]
+        children: dict[str, dict] = {}
+        if not slots:
+            return children
+        with ThreadPoolExecutor(
+                max_workers=len(slots),
+                thread_name_prefix="dvtpu-metrics-scrape") as pool:
+            pending = {s.sid: pool.submit(s.replica.metrics_dump)
+                       for s in slots}
+            for sid, fut in pending.items():
+                try:
+                    children[sid] = fut.result()
+                except Exception:
+                    continue
+        return children
+
+    def render_metrics(self) -> str:
+        """The fleet's single aggregated Prometheus surface
+        (obs/distributed.py federation): the router's own ``router_*``
+        families plus every replica's ``serve_*`` families labelled
+        ``{replica="rN"}``, with exact counter sums and
+        reservoir-merged histogram quantiles — one scrape describes
+        the whole fleet."""
+        return render_federated(self.metrics_children(),
+                                own=self.telemetry.registry,
+                                label="replica", own_label="router")
+
     def health(self) -> dict:
         """Fleet liveness for ``/healthz``: ok while >= 1 replica is
         READY; 503 (with a re-probe hint) while the whole fleet is

@@ -102,6 +102,10 @@ class ServeTelemetry:
 
     def __init__(self, registry: Registry | None = None):
         reg = registry if registry is not None else default_registry()
+        # kept public: a replica's metrics_dump() federates THIS
+        # registry up to the fleet router (obs/distributed.py), so an
+        # EngineReplica's private registry is scrapeable without HTTP
+        self.registry = reg
         self._lock = threading.Lock()
         self._c = {f: reg.register(f"serve_{f}", Counter())
                    for f in _COUNTER_FIELDS}

@@ -142,10 +142,14 @@ def build_fleet(args):
         + ["--top", str(args.top), "--score", str(args.score),
            "--max-queue", str(args.max_queue),
            "--batch-window-ms", str(args.batch_window_ms),
-           "--timeout-s", str(args.timeout_s)])
+           "--timeout-s", str(args.timeout_s)]
+        + (["--trace-spool", args.trace_spool]
+           if args.trace_spool else []))
 
     def factory(sid: str):
-        return ProcessReplica(sid, child_argv)
+        # each replica spools/dumps under its slot id, so the merged
+        # fleet trace names its pid rows r1/r2/...
+        return ProcessReplica(sid, child_argv + ["--obs-role", sid])
 
     injector = None
     if args.faults:
@@ -178,6 +182,45 @@ def build_fleet(args):
     )
     print(f"fleet up: {router.health()}", file=sys.stderr)
     return router
+
+
+def _setup_obs(args, role: str):
+    """Wire this process's distributed-observability surfaces: label
+    the tracer, attach a span spool when ``--trace-spool`` (or the
+    ``DVTPU_TRACE_SPOOL`` env a parent exported) names a directory,
+    and install the always-on flight recorder with a dump-on-SIGTERM
+    handler — so a drained/preempted replica leaves its black box next
+    to its spool. Returns the spool (or None)."""
+    import os
+    import signal
+
+    from deepvision_tpu.obs.distributed import (
+        ENV_SPOOL,
+        SpanSpool,
+        enable_spool_from_env,
+        flight_dump,
+        install_flight_recorder,
+    )
+    from deepvision_tpu.obs.trace import get_tracer
+
+    get_tracer().set_labels(role=role)
+    if args.trace_spool:
+        spool = SpanSpool(args.trace_spool, role=role)
+    else:
+        spool = enable_spool_from_env(role=role)
+    obs_dir = args.trace_spool or os.environ.get(ENV_SPOOL)
+    install_flight_recorder(obs_dir, meta={"role": role})
+
+    def _on_sigterm(sig, frame):
+        # black box first, then a GRACEFUL exit: SystemExit propagates
+        # out of serve_forever/stdin so the finally blocks run — the
+        # engine/router closes, child replicas are stopped (a fleet
+        # parent dying abruptly would orphan them), spools flush
+        flight_dump(f"signal-{sig}")
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    return spool
 
 
 def _jsonable(obj):
@@ -238,7 +281,8 @@ def run_stdin(engine, args, stdin=None, stdout=None):
         t0 = time.perf_counter()
         try:
             fut = engine.submit(x, model=req.get("model"),
-                                timeout_s=args.timeout_s)
+                                timeout_s=args.timeout_s,
+                                trace=req.get("trace"))
         except ShedError as e:
             print(json.dumps({"id": rid, "error": str(e),
                               "retry_after": e.retry_after_s}),
@@ -271,6 +315,8 @@ def make_handler(engine, args):
     # static after build_engine: resolved once so the (load-balancer-
     # hammered) /healthz probe never pays a full stats() snapshot
     models = engine.stats()["models"]
+
+    from deepvision_tpu.obs.distributed import TRACE_HEADER
 
     class Handler(http.server.BaseHTTPRequestHandler):
         # HTTP/1.1: keep-alive connections, so a router/load-balancer
@@ -325,9 +371,24 @@ def make_handler(engine, args):
                 # guarantee via the engine lock the handler didn't hold
                 self._send(200, engine.stats())
             elif self.path == "/metrics":
-                self._send_text(200, _render_metrics(),
+                # a fleet router renders the FEDERATED surface (its own
+                # router_* families + every replica's serve_* families
+                # with {replica=...} labels and exact counter sums); a
+                # single engine renders the process registry as before
+                render = getattr(engine, "render_metrics", None)
+                self._send_text(200,
+                                render() if render is not None
+                                else _render_metrics(),
                                 "text/plain; version=0.0.4; "
                                 "charset=utf-8")
+            elif self.path == "/metrics.json":
+                # the typed registry dump (histogram reservoirs
+                # included): what a fleet router scrapes from each
+                # replica to federate exactly instead of re-parsing
+                # lossy quantile text
+                from deepvision_tpu.obs.metrics import default_registry
+
+                self._send(200, default_registry().dump())
             else:
                 self._send(404, {"error": "not found"})
 
@@ -353,9 +414,15 @@ def make_handler(engine, args):
             except (ValueError, KeyError, TypeError) as e:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
+            # distributed trace id: the router hop carries it as the
+            # X-DVTPU-Trace header (the JSONL surface as a "trace"
+            # field) — the engine stamps its queue/device/postprocess
+            # spans with it so the merged fleet trace links this
+            # request across processes
+            trace = self.headers.get(TRACE_HEADER) or req.get("trace")
             try:
                 fut = engine.submit(x, model=req.get("model"),
-                                    timeout_s=timeout_s)
+                                    timeout_s=timeout_s, trace=trace)
                 result = fut.result(timeout=timeout_s + 1.0)
             except ShedError as e:
                 self._send(429, {"error": str(e),
@@ -513,11 +580,24 @@ def main(argv=None):
                    help="capture a jax.profiler trace of the whole "
                         "serving session into this directory (started "
                         "after warmup, stopped at shutdown)")
+    p.add_argument("--trace-spool", default=None, metavar="DIR",
+                   help="distributed tracing: append every completed "
+                        "span to a crash-safe per-process spool file "
+                        "under DIR (fleet mode forwards it to every "
+                        "replica); merge the fleet's spools into ONE "
+                        "Perfetto trace with tools/trace_merge.py. "
+                        "Flight-recorder dumps land in the same DIR")
+    p.add_argument("--obs-role", default=None,
+                   help="process label on spans/spools/dumps (fleet "
+                        "mode sets each replica's slot id "
+                        "automatically; default: router/replica by "
+                        "mode)")
     args = p.parse_args(argv)
 
     if args.fleet is not None:
         # fleet mode: router over child processes, no jax in THIS
         # process (the replicas compile; the router only routes)
+        spool = _setup_obs(args, args.obs_role or "router")
         router = build_fleet(args)
         try:
             if args.http is not None:
@@ -526,12 +606,15 @@ def main(argv=None):
                 run_stdin(router, args)
         finally:
             router.close()
+            if spool is not None:
+                spool.close()
             # grep-stable exit line: the router smoke gate asserts it
             print(router.summary_line(), file=sys.stderr, flush=True)
         return
 
     from deepvision_tpu.obs.profiler import profile_session
 
+    spool = _setup_obs(args, args.obs_role or "replica")
     engine = build_engine(args)
     try:
         # the profiler bracket starts AFTER build_engine so warmup
@@ -543,6 +626,8 @@ def main(argv=None):
                 run_stdin(engine, args)
     finally:
         engine.close()
+        if spool is not None:
+            spool.close()
 
 
 if __name__ == "__main__":

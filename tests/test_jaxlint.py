@@ -1795,3 +1795,75 @@ def test_load_config_reads_sentinel_funcs(tmp_path):
     # defaults cover the Trainer's epoch loop naming
     assert "*epoch*" in LintConfig().sentinel_funcs
     assert "*fit*" in LintConfig().sentinel_funcs
+
+
+# ----------------------------------------------------------- JX117
+
+
+def test_jx117_flags_unsynced_span_over_step(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        from deepvision_tpu.obs.trace import span
+
+        def run(state, batches, key):
+            for b in batches:
+                with span("step"):
+                    state, m = my_train_step(state, b, key)
+                # span closed right after the async dispatch: the
+                # trace now says the step took microseconds
+            with get_tracer().span("eval"):
+                m = my_eval_step(state, b)   # method-form span: same lie
+            return state, m
+        """)
+    assert codes(r) == ["JX117", "JX117"]
+    assert "device_sync" in r.findings[0].message
+
+
+def test_jx117_passes_synced_and_unrelated_spans(tmp_path):
+    r = lint(tmp_path, "lib/loop.py", """
+        import jax
+        from deepvision_tpu.obs.trace import span
+
+        def run(state, batches, key, feed):
+            for b in batches:
+                with span("step") as sp:
+                    state, m = my_train_step(state, b, key)
+                    sp.device_sync(m)            # end stamp waits
+            with span("eval", device_sync=state):  # ctor-form sync
+                state, m = my_eval_step(state, b)
+            with span("eval2"):
+                m = my_eval_step(state, b)
+                host = jax.device_get(m)         # fetch = sync too
+            with span("fetch"):
+                b = next(feed)                   # no step call timed
+            return state, m, host, b
+        """)
+    assert codes(r) == []
+
+
+def test_jx117_span_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(span_funcs=["run_compiled*"])
+    r = lint(tmp_path, "lib/loop.py", """
+        from deepvision_tpu.obs.trace import span
+
+        def run(state, b):
+            with span("fwd"):
+                y = run_compiled_fwd(state, b)   # matched by knob
+            with span("step"):
+                state, m = my_train_step(state, b)  # NOT matched now
+            return y, m
+        """, cfg=cfg)
+    assert codes(r) == ["JX117"]
+
+
+def test_load_config_reads_span_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        span_funcs = ["run_compiled*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.span_funcs == ["run_compiled*"]
+    # defaults share the JX111/JX112 step-call naming
+    assert "*_train_step" in LintConfig().span_funcs

@@ -1,0 +1,492 @@
+"""Fleet-wide observability (deepvision_tpu/obs/distributed.py +
+tools/trace_merge.py): trace-id propagation router -> replica, span
+spool write/merge round-trips with clock-offset correction and
+missing/torn-spool tolerance, federated metrics math against
+hand-computed truth, the flight recorder's dump-on-signal path, and
+the ring-overflow honesty counter."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from deepvision_tpu.obs.distributed import (  # noqa: E402
+    FlightRecorder,
+    SpanSpool,
+    merge_histograms,
+    new_trace_id,
+    parse_prometheus,
+    read_spool,
+    render_federated,
+    spool_paths,
+)
+from deepvision_tpu.obs.metrics import Registry  # noqa: E402
+from deepvision_tpu.obs.trace import Tracer, get_tracer  # noqa: E402
+from tools import trace_merge  # noqa: E402
+
+
+class _Capture:
+    """Sink collecting every span record the tracer emits."""
+
+    def __init__(self):
+        self.records: list[dict] = []
+
+    def __call__(self, rec: dict) -> None:
+        self.records.append(rec)
+
+
+# ------------------------------------------------- trace-id propagation
+
+
+def test_trace_id_propagates_router_to_engine_replica():
+    """One routed request's router_attempt span and the replica-side
+    replica_queue/device spans share ONE trace id — the propagation
+    contract the merged fleet trace's flows are built from."""
+    from tests.test_router import engine_factory, expected_toy
+
+    from deepvision_tpu.serve.router import FleetRouter
+
+    cap = _Capture()
+    tracer = get_tracer()
+    tracer.add_sink(cap)
+    try:
+        with FleetRouter(engine_factory(), replicas=1,
+                         models=["toy"]) as router:
+            fut = router.submit(np.ones(3, np.float32), model="toy")
+            assert fut.result(timeout=10)["y"] == expected_toy(
+                np.ones(3))
+            # postprocess spans land after the future resolves; give
+            # the dispatcher its loop iteration
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if any(r["name"] == "postprocess" for r in cap.records):
+                    break
+                time.sleep(0.01)
+    finally:
+        tracer.remove_sink(cap)
+
+    by_name = {}
+    for r in cap.records:
+        by_name.setdefault(r["name"], []).append(r)
+    attempt = by_name["router_attempt"][0]
+    tid = attempt["args"]["trace"]
+    assert len(tid) == 16
+    assert by_name["replica_queue"][0]["args"]["trace"] == tid
+    assert tid in by_name["device"][0]["args"]["traces"]
+    assert by_name["postprocess"][0]["args"]["trace"] == tid
+
+
+def test_explicit_trace_id_wins_over_minted():
+    """An upstream surface's trace id (the JSONL "trace" field / the
+    X-DVTPU-Trace header) is honored, not replaced."""
+    from tests.test_router import engine_factory
+
+    from deepvision_tpu.serve.router import FleetRouter
+
+    cap = _Capture()
+    tracer = get_tracer()
+    tracer.add_sink(cap)
+    try:
+        with FleetRouter(engine_factory(), replicas=1,
+                         models=["toy"]) as router:
+            fut = router.submit(np.ones(3, np.float32), model="toy",
+                                trace="cafecafecafecafe")
+            fut.result(timeout=10)
+    finally:
+        tracer.remove_sink(cap)
+    attempts = [r for r in cap.records if r["name"] == "router_attempt"]
+    assert attempts[0]["args"]["trace"] == "cafecafecafecafe"
+
+
+def test_new_trace_ids_are_unique():
+    ids = {new_trace_id() for _ in range(256)}
+    assert len(ids) == 256
+
+
+# -------------------------------------------- spool write/merge round trip
+
+
+def test_spool_merge_corrects_clock_offset_and_tolerates_torn_tail(
+        tmp_path):
+    """Two processes whose monotonic clocks started 5s apart merge onto
+    one wall timeline in the true order; a torn final line (SIGKILL
+    mid-write — the 'killed child' case) drops silently and the merge
+    still succeeds on the surviving evidence."""
+    t_router = Tracer()
+    t_router.set_labels(role="router")
+    t_replica = Tracer()
+    t_replica.set_labels(role="r1")
+    # the replica's tracer epoch (monotonic zero) maps to a wall time
+    # 5s BEFORE the router's — exactly what differing process start
+    # times produce
+    t_replica.epoch_wall = t_router.epoch_wall - 5.0
+
+    s1 = SpanSpool(tmp_path, tracer=t_router)
+    s2 = SpanSpool(tmp_path, tracer=t_replica)
+    with t_router.span("router_side", args={"trace": "aa" * 8}):
+        pass
+    with t_replica.span("replica_side", args={"trace": "aa" * 8}):
+        pass
+    s1.close()
+    s2.close()
+    # a third, torn spool: a child SIGKILLed mid-line
+    torn = tmp_path / "trace-spool-dead-999.jsonl"
+    torn.write_text(json.dumps({"spool": 1, "pid": 999, "role": "dead",
+                                "epoch_wall": t_router.epoch_wall})
+                    + "\n" + '{"name": "half-writt')
+
+    paths = spool_paths(tmp_path)
+    assert len(paths) == 3
+    assert read_spool(torn)["events"] == []  # torn line dropped, no raise
+
+    merged = trace_merge.merge(trace_merge.collect(tmp_path))
+    xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    by = {e["name"]: e for e in xs}
+    # clock correction: the replica's span (earlier wall) is the
+    # timeline zero; the router's sits ~5s later despite both having
+    # near-zero monotonic offsets in their own clocks
+    assert by["replica_side"]["ts"] < by["router_side"]["ts"]
+    assert by["router_side"]["ts"] == pytest.approx(5e6, rel=0.2)
+    assert by["router_side"]["pid"] != by["replica_side"]["pid"]
+    # the shared trace id still produces a cross-process flow
+    assert merged["metadata"]["cross_process_flows"] == 1
+
+
+def test_spool_rotation_bounds_disk_and_keeps_reading(tmp_path):
+    t = Tracer()
+    t.set_labels(role="w")
+    spool = SpanSpool(tmp_path, tracer=t, max_bytes=2000)
+    for i in range(100):
+        with t.span(f"s{i}"):
+            pass
+    spool.close()
+    paths = spool_paths(tmp_path)
+    assert any(p.name.endswith(".1") for p in paths)  # rotated half
+    assert all(p.stat().st_size < 4000 for p in paths)  # bounded
+    events = sorted((e for p in paths for e in read_spool(p)["events"]),
+                    key=lambda e: e["wall"])
+    assert events and events[-1]["name"] == "s99"  # newest survives
+    # the merger folds both halves into ONE source: a rotated process
+    # renders as one pid row, not two with a split timeline
+    sources = trace_merge.collect(tmp_path)
+    assert len(sources) == 1
+    merged = trace_merge.merge(sources)
+    pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert len(pids) == 1
+    assert len(events) == len([e for e in merged["traceEvents"]
+                               if e.get("ph") == "X"])
+
+
+def test_spool_recalibrates_after_tracer_reepoch(tmp_path):
+    t = Tracer()
+    spool = SpanSpool(tmp_path, tracer=t, role="w")
+    with t.span("before"):
+        pass
+    t.clear()  # re-epoch: new monotonic zero, new wall calibration
+    with t.span("after"):
+        pass
+    spool.close()
+    data = read_spool(spool.path)
+    assert len(data["headers"]) == 2  # calibration re-emitted
+    walls = {e["name"]: e["wall"] for e in data["events"]}
+    assert walls["before"] <= walls["after"]
+
+
+# ------------------------------------------------------ federated metrics
+
+
+def test_federated_counters_sum_exactly_and_label_children():
+    a, b = Registry(), Registry()
+    a.counter("serve_completed").inc(3)
+    b.counter("serve_completed").inc(5)
+    own = Registry()
+    own.counter("router_requests").inc(9)
+    text = render_federated({"r1": a.dump(), "r2": b.dump()}, own=own,
+                            label="replica")
+    series = parse_prometheus(text)
+    done = series["serve_completed_total"]
+    assert {ls["replica"]: v for ls, v in done if ls} \
+        == {"r1": 3.0, "r2": 5.0}
+    assert [v for ls, v in done if not ls] == [8.0]  # exact sum
+    assert series["router_requests_total"] == [({}, 9.0)]
+
+
+def test_federated_histograms_merge_reservoirs_vs_hand_truth():
+    """Federated quantiles come from the CONCATENATED reservoirs —
+    bit-identical to numpy over the union, never an average of
+    per-child quantiles."""
+    a, b = Registry(), Registry()
+    sa = [0.010, 0.020, 0.500]
+    sb = [0.030, 0.040]
+    for s in sa:
+        a.histogram("serve_e2e_latency").observe(s)
+    for s in sb:
+        b.histogram("serve_e2e_latency").observe(s)
+    text = render_federated({"r1": a.dump(), "r2": b.dump()})
+    series = parse_prometheus(text)
+    q = {ls["quantile"]: v
+         for ls, v in series["serve_e2e_latency"] if "quantile" in ls}
+    union = np.asarray(sorted(sa + sb), np.float64)
+    for quant in (0.5, 0.95, 0.99):
+        assert q[f"{quant:g}"] == pytest.approx(
+            float(np.percentile(union, quant * 100)), abs=1e-12)
+    assert series["serve_e2e_latency_sum"][0][1] == pytest.approx(
+        sum(sa) + sum(sb))
+    counts = series["serve_e2e_latency_count"]
+    assert {ls.get("replica"): v for ls, v in counts} \
+        == {"r1": 3.0, "r2": 2.0, None: 5.0}
+    # and merge_histograms' exact count/total half directly
+    m = merge_histograms([a.histogram("serve_e2e_latency").dump(),
+                          b.histogram("serve_e2e_latency").dump()])
+    assert (m["count"], m["total"]) == (5, pytest.approx(0.6))
+
+
+def test_federated_name_collision_folds_parent_as_child():
+    """A family both sides own (trace_dropped_spans) renders ONCE, the
+    parent folded in as one more labelled child — never two TYPE lines
+    for one name."""
+    child, own = Registry(), Registry()
+    child.counter("trace_dropped_spans").inc(2)
+    own.counter("trace_dropped_spans").inc(1)
+    text = render_federated({"r1": child.dump()}, own=own,
+                            label="replica", own_label="router")
+    assert text.count("# TYPE trace_dropped_spans_total") == 1
+    series = parse_prometheus(text)["trace_dropped_spans_total"]
+    assert {ls.get("replica"): v for ls, v in series} \
+        == {"r1": 2.0, "router": 1.0, None: 3.0}
+
+
+def test_fleet_router_render_metrics_federates_live_replicas():
+    from tests.test_router import engine_factory
+
+    from deepvision_tpu.serve.router import FleetRouter
+    from deepvision_tpu.serve.telemetry import RouterTelemetry
+
+    # isolated router registry: engines built by OTHER tests register
+    # serve_* into the process-default registry, and the collision
+    # fold would (correctly) report them as one more labelled child
+    with FleetRouter(engine_factory(), replicas=2, models=["toy"],
+                     telemetry=RouterTelemetry(registry=Registry())
+                     ) as router:
+        n = 6
+        futs = [router.submit(np.ones(3, np.float32), model="toy")
+                for _ in range(n)]
+        for f in futs:
+            f.result(timeout=10)
+        series = parse_prometheus(router.render_metrics())
+    done = series["serve_completed_total"]
+    labelled = {ls["replica"]: v for ls, v in done if ls}
+    assert set(labelled) == {"r1", "r2"}
+    assert [v for ls, v in done if not ls] == [float(n)]
+    assert series["router_completed_total"] == [({}, float(n))]
+
+
+def test_exposition_server_serves_typed_dump():
+    import urllib.request
+
+    from deepvision_tpu.obs.metrics import start_exposition_server
+
+    reg = Registry()
+    reg.counter("cluster_preemptions").inc(2)
+    reg.histogram("h").observe(0.25)
+    server, port = start_exposition_server(0, registry=reg,
+                                           host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json", timeout=5) as r:
+            dump = json.loads(r.read())
+        assert dump["cluster_preemptions"] == {"type": "counter",
+                                               "value": 2}
+        assert dump["h"]["samples"] == [0.25]
+        # and the text surface still parses
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert parse_prometheus(text)["cluster_preemptions_total"] \
+            == [({}, 2.0)]
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_dump_on_signal(tmp_path):
+    """A real child process: install the recorder with a SIGTERM
+    handler, kill it, and read the black box it left — spans, the
+    metric-delta note, and the reason."""
+    script = textwrap.dedent(f"""
+        import signal, sys, time
+        sys.path.insert(0, {str(Path(__file__).parent.parent)!r})
+        from deepvision_tpu.obs.distributed import install_flight_recorder
+        from deepvision_tpu.obs.metrics import default_registry
+        from deepvision_tpu.obs.trace import get_tracer
+
+        get_tracer().set_labels(role="child")
+        rec = install_flight_recorder({str(tmp_path)!r},
+                                      meta={{"role": "child"}},
+                                      signals=(signal.SIGTERM,))
+        default_registry().counter("work_done").inc(7)
+        with get_tracer().span("work"):
+            pass
+        rec.note("tick", step=3)
+        print("ready", flush=True)
+        time.sleep(60)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    dumps = list(tmp_path.glob("flightrec-child-signal-15-*.json"))
+    assert len(dumps) == 1
+    body = json.loads(dumps[0].read_text())
+    assert body["reason"] == "signal-15"
+    kinds = [(e["kind"], e.get("name") or e.get("label"))
+             for e in body["events"]]
+    assert ("span", "work") in kinds
+    assert ("note", "tick") in kinds
+    note = [e for e in body["events"] if e["kind"] == "note"][0]
+    assert note["step"] == 3
+    assert note["metrics"].get("work_done") == 7
+    assert body["snapshot"]["work_done"] == 7
+    # the default SIGTERM disposition was chained: the child DIED
+    assert proc.returncode != 0
+
+
+def test_flight_recorder_ring_is_bounded_and_notes_delta(tmp_path):
+    reg = Registry()
+    tracer = Tracer()
+    rec = FlightRecorder(tmp_path, capacity=8, registry=reg,
+                         tracer=tracer)
+    try:
+        reg.counter("c").inc(5)
+        rec.note("first")
+        reg.counter("c").inc(2)
+        rec.note("second")
+        for i in range(20):
+            with tracer.span(f"s{i}"):
+                pass
+        path = rec.dump("test")
+    finally:
+        rec.close()
+    body = json.loads(path.read_text())
+    assert len(body["events"]) == 8  # bounded ring
+    # deltas, not absolutes (the dump's snapshot carries absolutes)
+    notes = {e["label"]: e for e in body["events"]
+             if e["kind"] == "note"}
+    assert notes == {} or all(
+        e["metrics"].get("c") in (5, 2) for e in notes.values())
+    rec2_events = [e["name"] for e in body["events"]
+                   if e["kind"] == "span"]
+    assert rec2_events[-1] == "s19"  # newest survive the ring
+
+
+def test_quarantine_black_box_extraction_from_spool(tmp_path):
+    """The SIGKILL story: the culprit never ran a dump handler, but its
+    crash-safe spool + last metrics publication survive — the
+    supervisor extracts them into a flightrec the merger renders."""
+    from deepvision_tpu.resilience.cluster import (
+        ClusterMember,
+        ClusterSupervisor,
+    )
+
+    gen_dir = tmp_path / "cluster" / "gen-000"
+    gen_dir.mkdir(parents=True)
+    # the culprit's surviving evidence: spool + metrics publication
+    t = Tracer()
+    t.set_labels(role="host1", host=1, generation="gen-000")
+    spool = SpanSpool(gen_dir, tracer=t)
+    for i in range(3):
+        with t.span("step", args={"step": i}):
+            pass
+    spool.close()
+    reg = Registry()
+    reg.counter("sentinel_audits").inc(4)
+    member = ClusterMember(gen_dir, 1, 2, orig_host=1)
+    member._registry_dump = None  # publication path below
+    import deepvision_tpu.resilience.cluster as cluster_mod
+
+    # publish through the member's own path (it dumps the default
+    # registry; patch in our isolated one)
+    orig = cluster_mod.default_registry
+    cluster_mod.default_registry = lambda: reg
+    try:
+        member.publish_metrics(step=42)
+    finally:
+        cluster_mod.default_registry = orig
+
+    sup = ClusterSupervisor(["-m", "lenet5"], 2, tmp_path)
+    out = sup._extract_black_box(gen_dir, 1)
+    assert out == tmp_path / "flightrec-host1-quarantine.json"
+    body = json.loads(out.read_text())
+    assert body["reason"] == "quarantine"
+    assert [e["name"] for e in body["events"]] == ["step"] * 3
+    assert body["snapshot"]["sentinel_audits"]["value"] == 4
+    # and the merger renders it alongside the spools
+    merged = trace_merge.merge(trace_merge.collect(tmp_path))
+    rows = [e["args"]["name"] for e in merged["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert any("quarantine" in r for r in rows)
+
+
+def test_supervisor_federated_metrics_labels_hosts(tmp_path):
+    from deepvision_tpu.resilience.cluster import ClusterSupervisor
+
+    gen_dir = tmp_path / "cluster" / "gen-000"
+    gen_dir.mkdir(parents=True)
+    for idx, (host, n) in enumerate([(0, 3), (1, 4)]):
+        reg = Registry()
+        reg.counter("recovery_rollbacks").inc(n)
+        (gen_dir / f"metrics-{idx}.json").write_text(json.dumps(
+            {"host": host, "index": idx, "time": 0.0,
+             "dump": reg.dump()}))
+    sup = ClusterSupervisor(["-m", "lenet5"], 2, tmp_path,
+                            registry=Registry())
+    sup._live_dir = gen_dir
+    series = parse_prometheus(sup.render_federated_metrics())
+    rb = series["recovery_rollbacks_total"]
+    assert {ls["host"]: v for ls, v in rb if ls} == {"0": 3.0, "1": 4.0}
+    assert [v for ls, v in rb if not ls] == [7.0]
+
+
+# --------------------------------------------------- ring-overflow honesty
+
+
+def test_tracer_ring_overflow_is_counted_not_silent(tmp_path):
+    from deepvision_tpu.obs.metrics import default_registry
+
+    c = default_registry().counter("trace_dropped_spans")
+    before = c.value
+    t = Tracer(capacity=4)
+    t.enable()
+    for i in range(7):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t) == 4
+    assert t.dropped_spans == 3
+    assert c.value - before == 3
+    out = tmp_path / "trace.json"
+    t.export(out)
+    meta = json.loads(out.read_text())["metadata"]
+    assert meta["trace_dropped_spans"] == 3
+    assert meta["complete"] is False
+    t.clear()
+    assert t.dropped_spans == 0  # per-export honesty resets with the ring
+    t.export(out)
+    assert json.loads(out.read_text())["metadata"]["complete"] is True

@@ -96,7 +96,7 @@ class FakeReplica:
     def kill(self):
         self.dead = True
 
-    def request(self, model, x, *, timeout_s=None):
+    def request(self, model, x, *, timeout_s=None, trace=None):
         if self.dead:
             raise ReplicaDeadError(f"{self.replica_id}: dead")
         self.requests.append((model, np.asarray(x).tolist()))
@@ -728,7 +728,7 @@ def test_process_replica_forwards_deadline_to_child():
     rep = ProcessReplica("r1", argv=["unused"])
     seen = {}
 
-    def fake_http(method, path, body, timeout_s):
+    def fake_http(method, path, body, timeout_s, headers=None):
         seen["payload"] = json.loads(body)
         return 200, {}, b'{"result": {"y": [1.0]}}'
 

@@ -50,6 +50,12 @@ Checker codes (tools/jaxlint/checkers.py):
     JX115  blocking cluster join/barrier (distributed.initialize,
            wait_at_barrier, await_all_arrived, ...) without a timeout
            argument — a missing/dead peer hangs the process forever
+    JX116  per-step float()/np.asarray/device_get of a sent_* sentinel
+           output inside a step loop, outside the drain cadence
+           (re-introduces the JX109 host-sync stall)
+    JX117  `with span(...)` over a compiled-step call with no
+           device_sync/block_until_ready before the span end (the
+           JX112 async-dispatch lie recorded into the trace)
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

@@ -1335,3 +1335,89 @@ class SentinelFetchChecker(Checker):
                         and sub.attr.startswith("sent_"):
                     return True
         return False
+
+
+@register_checker
+class SpanSyncChecker(Checker):
+    """``with span(...)`` wrapping a compiled-step call with no device
+    sync before the span ends: the JX112 async-dispatch lie, now for
+    spans. A compiled call returns the moment the work is ENQUEUED, so
+    a span closed right after it measures dispatch (microseconds), not
+    compute — and a trace whose ``step`` spans are all 50us while the
+    chip grinds for 20ms misattributes the epoch to whatever span the
+    drain happens to land in. Honest forms the checker recognizes:
+    ``span(..., device_sync=out)`` at construction, ``sp.device_sync(
+    out)`` on the as-name, or ``block_until_ready`` / ``jax.device_get``
+    / ``jax.effects_barrier`` between the LAST step call and the span's
+    end. Which call names count as compiled steps is the ``span_funcs``
+    knob (``jaxlint.toml``). Loop spans that deliberately measure
+    dispatch+backpressure (the Trainer's ``step`` span — syncing would
+    serialize the async feed) carry an inline pragma with the
+    rationale."""
+
+    code = "JX117"
+    name = "unsynced-span-over-step"
+    description = ("`with span(...)` over a compiled-step call with no "
+                   "device_sync/block_until_ready before span end "
+                   "(the span times async dispatch, not compute)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.span_funcs
+        for info in mod.functions:
+            if info.parent is not None:
+                continue  # nested defs scan with their parent
+            for node in ast.walk(info.node):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    yield from self._check_with(mod, node, patterns)
+
+    def _check_with(self, mod: ModuleContext, node,
+                    patterns) -> Iterator[Finding]:
+        span_call = self._span_item(node)
+        if span_call is None:
+            return
+        if any(k.arg == "device_sync"
+               and not (isinstance(k.value, ast.Constant)
+                        and k.value.value is None)
+               for k in span_call.keywords):
+            return  # ctor-form sync: the span end blocks on the value
+        steps: list[tuple[int, str]] = []
+        syncs: list[int] = []
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or sub is span_call:
+                continue
+            cn = call_name(sub)
+            la = last_attr(cn)
+            if la in _DISPATCH_SYNC_ATTRS or la == "device_sync" or (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _DISPATCH_SYNC_ATTRS):
+                syncs.append(sub.lineno)
+            elif la and any(fnmatch.fnmatch(la, p) for p in patterns):
+                steps.append((sub.lineno, cn))
+        if not steps:
+            return
+        last_step_line, step_name = max(steps)
+        if any(ln >= last_step_line for ln in syncs):
+            return  # synced after (or beside) the last step call
+        yield mod.finding(
+            node, self.code,
+            f"span over compiled-step call '{step_name}' closes with "
+            "no device sync — async dispatch makes it time enqueue, "
+            "not compute; use `sp.device_sync(out)` (or span(..., "
+            "device_sync=...)) so the end stamp waits for the result")
+
+    @staticmethod
+    def _span_item(node) -> ast.Call | None:
+        """The ``span(...)``/``tracer.span(...)`` call of a With item,
+        if any."""
+        for item in node.items:
+            ctx = item.context_expr
+            if not isinstance(ctx, ast.Call):
+                continue
+            if last_attr(call_name(ctx)) == "span":
+                return ctx
+            # call-on-call receivers (get_tracer().span(...)) have no
+            # resolvable dotted name; the attribute still names it
+            if isinstance(ctx.func, ast.Attribute) \
+                    and ctx.func.attr == "span":
+                return ctx
+        return None

@@ -305,6 +305,15 @@ class LintConfig:
     sentinel_funcs: list[str] = field(default_factory=lambda: [
         "*epoch*", "*fit*", "*train_loop*", "*step_loop*",
     ])
+    # Call-name patterns treated as compiled-step invocations for the
+    # span-timing check (JX117): a `with span(...)` wrapping one with
+    # no device_sync/block_until_ready before the span end records the
+    # JX112 async-dispatch lie into the trace — the span times enqueue,
+    # not compute. Same default step-call naming as JX111/JX112.
+    span_funcs: list[str] = field(default_factory=lambda: [
+        "*_train_step", "*_eval_step", "*_step_fn", "train_step",
+        "eval_step",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -325,7 +334,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "key_fresheners", "key_name_patterns", "constraint_funcs",
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
         "timed_funcs", "loop_sleep_funcs", "wire_funcs",
-        "cluster_funcs", "sentinel_funcs", "disable",
+        "cluster_funcs", "sentinel_funcs", "span_funcs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""obs-fleet-smoke: the fleet-wide observability gate (`make check`).
+
+Boots a REAL 2-replica lenet5 process fleet (``serve.py --fleet 2
+--http``) with span spooling on, pushes a short request load through
+the router, and asserts the three distributed-obs contracts on live
+artifacts:
+
+1. **federated /metrics** — one scrape of the router parses as
+   Prometheus text, carries per-replica ``serve_completed_total``
+   samples for BOTH replicas, and their unlabelled sum line equals the
+   exact number of requests served (counter federation is sums, not
+   estimates);
+2. **cross-process trace assembly** — after a graceful SIGTERM (which
+   also exercises the flight-recorder dump-on-signal path in every
+   process), ``tools/trace_merge.py`` merges the router's and replicas'
+   spools into one Perfetto trace where >= 1 request's flow links a
+   ``router_attempt`` span to ``replica_queue``/``device`` spans in a
+   DIFFERENT process — trace-id propagation over the X-DVTPU-Trace hop,
+   proven on the merged artifact;
+3. **flight recorder** — every process of the fleet left a
+   ``flightrec-*-signal-15-*.json`` black box next to its spool.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if __package__ in (None, ""):
+    sys.path.insert(0, str(REPO))
+
+from deepvision_tpu.obs.distributed import parse_prometheus  # noqa: E402
+from tools import trace_merge  # noqa: E402
+
+N_REQUESTS = 12
+
+
+def _get(port: int, path: str, timeout: float = 10.0) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post(port: int, path: str, payload: dict,
+          timeout: float = 30.0) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, {}
+
+
+def main() -> int:
+    obs = Path(tempfile.mkdtemp(prefix="dvt-obs-fleet-"))
+    port_file = obs / "port"
+    log_path = obs / "fleet.log"
+    argv = [sys.executable, str(REPO / "serve.py"),
+            "--fleet", "2", "-m", "lenet5", "--buckets", "1,4",
+            "--http", "0", "--port-file", str(port_file),
+            "--trace-spool", str(obs)]
+    print(f"[obs-fleet-smoke] workdir {obs}; booting 2-replica fleet "
+          "(replicas compile)...", flush=True)
+    with open(log_path, "wb") as log:
+        proc = subprocess.Popen(argv, stdout=log, stderr=log,
+                                stdin=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 300
+        port = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                print(f"fleet exited rc={proc.returncode} during boot; "
+                      f"log: {log_path}", file=sys.stderr)
+                return 1
+            if port is None and port_file.exists():
+                try:
+                    port = int(port_file.read_text().strip())
+                except ValueError:
+                    port = None
+            if port is not None:
+                try:
+                    status, _ = _get(port, "/healthz", timeout=3.0)
+                    if status == 200:
+                        break
+                except OSError:
+                    pass
+            time.sleep(0.25)
+        else:
+            print(f"fleet not healthy within 300s; log: {log_path}",
+                  file=sys.stderr)
+            return 1
+
+        x = [[[0.0]] * 32 for _ in range(32)]  # 32x32x1 zeros
+        ok = 0
+        for i in range(N_REQUESTS):
+            status, body = _post(port, "/v1/predict",
+                                 {"model": "lenet5", "input": x})
+            if status == 200 and "result" in body:
+                ok += 1
+        assert ok == N_REQUESTS, \
+            f"only {ok}/{N_REQUESTS} requests served; log: {log_path}"
+
+        status, body = _get(port, "/metrics")
+        assert status == 200, f"/metrics HTTP {status}"
+        series = parse_prometheus(body.decode())
+        completed = series.get("serve_completed_total", [])
+        labelled = {ls["replica"]: v for ls, v in completed if ls}
+        plain = [v for ls, v in completed if not ls]
+        assert len(labelled) == 2, \
+            f"expected 2 replica-labelled samples, got {labelled}"
+        assert plain and plain[0] == sum(labelled.values()), \
+            f"sum line {plain} != per-replica sum {labelled}"
+        assert plain[0] == N_REQUESTS, \
+            f"federated completed {plain[0]} != offered {N_REQUESTS}"
+        router_done = [v for ls, v in
+                       series.get("router_completed_total", []) if not ls]
+        assert router_done == [float(N_REQUESTS)], router_done
+        print(f"[obs-fleet-smoke] federated /metrics OK: "
+              f"per-replica {labelled} sums to {int(plain[0])} "
+              f"== {N_REQUESTS} offered", flush=True)
+
+        # graceful SIGTERM: flight recorders dump, router closes the
+        # replicas (their SIGTERM handlers dump too), spools flush
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+
+    dumps = sorted(obs.glob("flightrec-*.json"))
+    assert dumps, f"no flight-recorder dumps under {obs}"
+    roles = {json.loads(p.read_text()).get("labels", {}).get("role")
+             for p in dumps}
+    print(f"[obs-fleet-smoke] flight-recorder dumps: "
+          f"{[p.name for p in dumps]} (roles {sorted(map(str, roles))})",
+          flush=True)
+    assert "router" in roles, f"router never dumped: {roles}"
+    assert any(str(r).startswith("r") and str(r) != "router"
+               for r in roles), f"no replica dump: {roles}"
+
+    rc = trace_merge.main([
+        str(obs), "--assert-flow",
+        "--assert-spans", "router_attempt,replica_queue,device"])
+    if rc != 0:
+        return rc
+    print("obs-fleet-smoke OK (cross-process flows + exact federated "
+          "sums + flight-recorder dumps)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -133,16 +133,6 @@ def run_supervisor(dist_args, train_argv) -> int:
             train_argv = [*train_argv, "--faults", rest,
                           "--fault-seed", str(dist_args.fault_seed)]
     workdir = argv_value(train_argv, "--workdir") or "runs"
-    server = None
-    if dist_args.metrics_port is not None:
-        # the multi-host scrape surface: the ledger's liveness gauges
-        # and the sentinel_* SDC counters land in the default registry,
-        # which this endpoint renders (obs/metrics.py exposition)
-        from deepvision_tpu.obs.metrics import start_exposition_server
-
-        server, port = start_exposition_server(dist_args.metrics_port)
-        print(f"[cluster] Prometheus metrics on :{port}/metrics",
-              flush=True)
     sup = ClusterSupervisor(
         train_argv, dist_args.supervise, workdir,
         launcher=__file__,
@@ -157,6 +147,21 @@ def run_supervisor(dist_args, train_argv) -> int:
         barrier_timeout_s=dist_args.barrier_timeout_s,
         max_relaunches=dist_args.max_relaunches,
     )
+    server = None
+    if dist_args.metrics_port is not None:
+        # the multi-host scrape surface, now FEDERATED
+        # (obs/distributed.py): the supervisor's own registry (liveness
+        # gauges + sentinel_* SDC counters) plus every live host's
+        # registry dump — published on the heartbeat cadence into the
+        # generation dir — re-exported with {host=<id>} labels and
+        # exact counter sums, so one scrape describes the whole fleet
+        from deepvision_tpu.obs.metrics import start_exposition_server
+
+        server, port = start_exposition_server(
+            dist_args.metrics_port,
+            render_fn=sup.render_federated_metrics)
+        print(f"[cluster] Prometheus metrics on :{port}/metrics "
+              "(federated over the live hosts)", flush=True)
     try:
         return sup.run()
     finally:
