@@ -55,6 +55,17 @@ lint:
 	$(PY) -m tools.jaxlint.evalcheck
 	$(PY) -m tools.jaxlint.ircheck --fast
 
+# concurrency tier only (ISSUE 14, tools/jaxlint/concurrency.py):
+# JX118 unguarded shared state, JX119 blocking call under lock, JX120
+# lock-order deadlock graph (incl. lock-across-collective), JX121
+# fork-unsafe multiprocessing after jax/tf import, JX122 signal-handler
+# safety. The full `make lint` sweep above already runs these five —
+# this target is the fast (~10s) entry point when touching only
+# threads/locks, and what CI greps when a concurrency finding fires.
+lint-threads:
+	$(PY) -m tools.jaxlint --select JX118,JX119,JX120,JX121,JX122 \
+	    $(LINT_PATHS)
+
 # compiled-IR contract gate, registry-wide (tools/jaxlint/ircheck.py):
 # lowers the REAL train step of every registry model and verifies
 # donation aliasing (JX104 enforcement), dtype discipline (no f64, no
@@ -214,11 +225,34 @@ chaos-sdc-smoke:
 	grep -qE "\[sentinel\] trips=0 audits=[0-9]+ divergences=1 quarantined=1" "$$L" && \
 	echo "chaos-sdc-smoke OK (silent SDC caught <= K, host 1 quarantined by replay bisection, survivor completed)"
 
+# runtime thread-sanitizer gate (tools/jaxlint/threadcheck.py): the
+# static tier above proves lock DISCIPLINE from source; this proves the
+# locks the serving/cluster tiers ACTUALLY take at runtime form an
+# acyclic acquisition order. Two legs: (1) --smoke boots a real
+# engine + 2-replica router lifecycle under instrumented locks and
+# asserts acyclicity + exports the Perfetto-loadable lock graph JSON;
+# (2) the engine/router/cluster lifecycle tests re-run with
+# DVTPU_THREADCHECK=1 — every Lock/RLock the suite creates is
+# sanitized, the session fixture in tests/conftest.py asserts the
+# observed graph is acyclic at teardown and exports it beside the
+# PR 11 spools (logs/lockgraph-tier1.json)
+threadcheck-smoke:
+	@mkdir -p logs; L="logs/threadcheck-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	rm -f logs/lockgraph-tier1.json; \
+	$(PY) -m tools.jaxlint.threadcheck --smoke \
+	    --export logs/lockgraph-smoke.json 2>&1 | tee "$$L" && \
+	grep -q "threadcheck-smoke OK" "$$L" && \
+	DVTPU_THREADCHECK=1 DVTPU_THREADCHECK_EXPORT=logs/lockgraph-tier1.json \
+	$(PY) -m pytest tests/test_serve.py tests/test_router.py \
+	    tests/test_cluster.py -x -q 2>&1 | tee -a "$$L" && \
+	test -s logs/lockgraph-tier1.json && \
+	echo "threadcheck-smoke OK (engine+router lifecycle + tier re-run acyclic)"
+
 # the default CI path: hazard lint + serving smoke + chaos smoke +
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke
+check: lint serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -342,4 +376,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-ir bf16-ready check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-threads lint-ir bf16-ready check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal

@@ -81,6 +81,44 @@ class FolderLoader:
         self.shuffle = shuffle
         self.num_workers = num_workers
         self.drop_remainder = drop_remainder
+        self._pool = None
+
+    def _get_pool(self):
+        """Lazily create — and then REUSE across epochs — the worker
+        pool. spawn, never the platform-default fork (jaxlint JX121):
+        this loader runs inside training processes where jax/tf
+        runtime threads already hold internal mutexes — a forked child
+        inherits them locked with no owner thread and wedges on first
+        use (the PR 2 tier-1 deadlock). spawn startup is seconds (a
+        fresh interpreter per worker + the pickled dataset handle:
+        paths + numpy labels + module-level transform classes, shipped
+        once via the initializer), which is why the pool persists for
+        the loader's lifetime instead of being rebuilt per epoch —
+        work items carry (index, epoch), so workers are epoch-blind."""
+        if self._pool is None:
+            self._pool = mp.get_context("spawn").Pool(
+                self.num_workers, initializer=_init_worker,
+                initargs=(self.dataset,))
+        return self._pool
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.close()
+            self._pool.join()
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            if self._pool is not None:
+                self._pool.terminate()
+        except Exception:
+            pass  # interpreter teardown: best-effort only
 
     def epoch(self, epoch: int = 0):
         n = len(self.dataset)
@@ -88,23 +126,14 @@ class FolderLoader:
         if self.shuffle:
             np.random.default_rng(epoch).shuffle(order)
         end = n - n % self.batch_size if self.drop_remainder else n
-        pool = (
-            mp.Pool(self.num_workers, initializer=_init_worker,
-                    initargs=(self.dataset,))
-            if self.num_workers > 1 else None
-        )
-        try:
-            for s in range(0, end, self.batch_size):
-                idx = order[s : s + self.batch_size]
-                work = [(int(i), epoch) for i in idx]
-                if pool is not None:
-                    samples = pool.map(_load_one, work)
-                else:
-                    samples = [self.dataset.load(i, e) for i, e in work]
-                images = np.stack([im for im, _ in samples])
-                labels = np.array([lb for _, lb in samples], np.int32)
-                yield {"image": images, "label": labels}
-        finally:
+        pool = self._get_pool() if self.num_workers > 1 else None
+        for s in range(0, end, self.batch_size):
+            idx = order[s : s + self.batch_size]
+            work = [(int(i), epoch) for i in idx]
             if pool is not None:
-                pool.close()
-                pool.join()
+                samples = pool.map(_load_one, work)
+            else:
+                samples = [self.dataset.load(i, e) for i, e in work]
+            images = np.stack([im for im, _ in samples])
+            labels = np.array([lb for _, lb in samples], np.int32)
+            yield {"image": images, "label": labels}

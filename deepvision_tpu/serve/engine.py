@@ -147,6 +147,11 @@ class InferenceEngine:
             name: [] for name in self._models}
         self._in_flight: list[_Request] = []
         self._recovering = threading.Event()
+        # guards _recover_until: written by the supervisor thread,
+        # read by health() probes from any thread (jaxlint JX118 — the
+        # Event alone orders the write but a linter, and the next
+        # maintainer, should not have to prove publication order)
+        self._health_lock = threading.Lock()
         self._recover_until = 0.0  # monotonic end of the backoff window
         self._injector = fault_injector
         self._restart_backoff_s = restart_backoff_s
@@ -301,8 +306,10 @@ class InferenceEngine:
             # when to re-probe: the rest of the backoff window — the
             # /healthz 503 carries it as Retry-After so load balancers
             # re-probe on schedule instead of hammering or forgetting
+            with self._health_lock:
+                until = self._recover_until
             out["retry_after_s"] = round(
-                max(0.05, self._recover_until - time.monotonic()), 3)
+                max(0.05, until - time.monotonic()), 3)
         return out
 
     # pause/resume: used by drains and tests that need deterministic
@@ -348,7 +355,8 @@ class InferenceEngine:
                     return
                 if time.monotonic() - t0 > self._backoff_reset_s:
                     backoff = self._restart_backoff_s
-                self._recover_until = time.monotonic() + backoff
+                with self._health_lock:
+                    self._recover_until = time.monotonic() + backoff
                 self._recovering.set()
                 self._stop.wait(backoff)  # close() wakes this instantly
                 self._recovering.clear()

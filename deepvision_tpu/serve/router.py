@@ -389,7 +389,9 @@ class FleetRouter:
     def start(self) -> None:
         """Start the initial fleet (replicas boot in parallel) and the
         probe/supervisor thread. Raises if NO replica comes up."""
-        threads = [self._spawn_slot_async() for _ in range(self._target)]
+        with self._lock:
+            target = self._target
+        threads = [self._spawn_slot_async() for _ in range(target)]
         for t in threads:
             t.join()
         if not self._ready_slots():
@@ -411,7 +413,9 @@ class FleetRouter:
             t.join(timeout)
         self._pool.shutdown(wait=True)
         self._attempt_pool.shutdown(wait=True)
-        for th in list(self._respawners):
+        with self._lock:
+            respawners = list(self._respawners)
+        for th in respawners:
             th.join(timeout)
         with self._lock:
             slots = list(self._slots)
@@ -713,7 +717,8 @@ class FleetRouter:
 
         t = threading.Thread(target=boot, name=f"router-boot-{sid}")
         t.start()
-        self._respawners.append(t)
+        with self._lock:
+            self._respawners.append(t)
         return t
 
     def _ready_slots(self) -> list[_Slot]:
@@ -760,8 +765,8 @@ class FleetRouter:
         th = threading.Thread(target=self._stop_replica, args=(slot,),
                               name=f"router-retire-{slot.sid}")
         th.start()
-        self._respawners.append(th)
         with self._lock:
+            self._respawners.append(th)
             if slot in self._slots:
                 self._slots.remove(slot)
         self.telemetry.inc("scale_downs")
@@ -790,7 +795,8 @@ class FleetRouter:
             t = threading.Thread(target=self._kill_replica, args=(s,),
                                  name=f"router-reap-{s.sid}")
             t.start()
-            self._respawners.append(t)
+            with self._lock:
+                self._respawners.append(t)
         if dead:
             # fresh deaths push the next respawn wave out and escalate
             self._respawn_not_before = max(self._respawn_not_before,
@@ -808,7 +814,9 @@ class FleetRouter:
             self._spawn_slot_async()
 
     def _gc_respawners(self) -> None:
-        self._respawners = [t for t in self._respawners if t.is_alive()]
+        with self._lock:
+            self._respawners = [t for t in self._respawners
+                                if t.is_alive()]
 
     # -- signals + autoscaling -------------------------------------------
     def _publish_signals_and_autoscale(self) -> None:
@@ -863,23 +871,24 @@ class FleetRouter:
             dispatcher_crashes=reg.value_of("router_dispatcher_crashes"),
             target=self._target)
         if new_target > self._target:
-            self._target = new_target
+            with self._lock:
+                self._target = new_target
             tel.inc("scale_ups")
             print(f"[router] autoscale up -> {new_target} "
                   f"(queue_p95={queue_p95:.1f}ms "
                   f"shed_rate={shed_rate:.2f}/s)", file=sys.stderr, flush=True)
             self._spawn_slot_async()
         elif new_target < self._target:
-            self._target = new_target
             with self._lock:
+                self._target = new_target
                 ready = [s for s in self._slots if s.state == READY]
                 victim = (min(ready, key=lambda s: (s.inflight, s.sid))
                           if len(ready) > 1 else None)
                 if victim is not None:
                     victim.state = RETIRING
-            if victim is None:
-                self._target = new_target + 1  # nothing safely drainable
-            else:
+                else:
+                    self._target = new_target + 1  # nothing drainable
+            if victim is not None:
                 print(f"[router] autoscale down -> {new_target} "
                       f"(draining {victim.sid})", file=sys.stderr, flush=True)
         tel.replicas_target.set(self._target)
@@ -930,11 +939,13 @@ class FleetRouter:
         down/draining — the same contract a replica's own /healthz has,
         one level up."""
         ready = len(self._ready_slots())
+        with self._lock:
+            target = self._target
         status = "ok" if ready > 0 else "recovering"
         out = {
             "status": status,
             "replicas_ready": ready,
-            "replicas_target": self._target,
+            "replicas_target": target,
         }
         if status != "ok":
             out["retry_after_s"] = round(2 * self._probe_interval_s, 3)
@@ -947,10 +958,11 @@ class FleetRouter:
                 "state": s.state,
                 "inflight": s.inflight,
             } for s in self._slots]
+            target = self._target
         return {
             "models": sorted(self._models),
             "replicas": replicas,
-            "target_replicas": self._target,
+            "target_replicas": target,
             "slo_budgets_s": dict(self._slo),
             "queue": self._admission.stats(),
             "breakers": {k: b.snapshot()

@@ -106,6 +106,23 @@ if "OPTS_OK" in _feats:
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 
+# Runtime thread-sanitizer (ISSUE 14, tools/jaxlint/threadcheck.py):
+# DVTPU_THREADCHECK=1 patches threading.Lock/RLock BEFORE jax (and the
+# suite's engines/routers/registries) create any locks, records the
+# live lock-acquisition graph across the whole session, asserts
+# acyclicity at teardown, and exports a Perfetto-loadable graph JSON.
+# Installed here — before the jax import below — so even import-time
+# locks of the libraries under test are instrumented.
+_THREADCHECK = None
+if os.environ.get("DVTPU_THREADCHECK"):
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.jaxlint import threadcheck as _tc
+
+    _THREADCHECK = _tc.install()
+
 import jax
 
 # Force CPU via jax.config: the session may pin JAX_PLATFORMS to a TPU
@@ -113,8 +130,86 @@ import jax
 if not os.environ.get("DVT_TEST_ON_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
+import faulthandler
+
 import numpy as np
 import pytest
+
+# Deadlock watchdog (ISSUE 14 satellite): any future tier-1 wedge must
+# leave ALL-THREAD stack dumps in the log instead of dying as a silent
+# 870s timeout kill (the PR 1/PR 2 "cut mid-run" mystery, made
+# impossible to recur undiagnosed). faulthandler.enable() covers hard
+# crashes (SIGSEGV/SIGABRT — how the XLA rendezvous F-check already
+# surfaces); dump_traceback_later is re-armed PER TEST below, so a
+# single test stuck past the budget dumps every thread's stack and
+# keeps running (exit=False) — the driver's timeout still bounds the
+# suite, but the artifact now says WHERE it wedged.
+faulthandler.enable()
+_TEST_DUMP_S = float(os.environ.get("DVTPU_TEST_DUMP_S", "600"))
+# Dumps go to a FILE, not stderr: pytest's default fd-level capture
+# redirects fd 2 into a per-test temp file, so a mid-test dump written
+# to stderr is exactly the artifact a driver's hard kill destroys.
+# logs/pytest-wedge-<pid>.log survives the SIGKILL; it is deleted at
+# teardown when no dump fired so a green run leaves nothing behind.
+_WEDGE_LOG_PATH = None
+_WEDGE_LOG = None
+if _TEST_DUMP_S > 0:
+    import pathlib as _pl
+
+    _WEDGE_LOG_PATH = _pl.Path(__file__).parent.parent / "logs" / \
+        f"pytest-wedge-{os.getpid()}.log"
+    _WEDGE_LOG_PATH.parent.mkdir(exist_ok=True)
+    _WEDGE_LOG = open(_WEDGE_LOG_PATH, "w")
+
+
+@pytest.fixture(autouse=True)
+def _wedge_watchdog(request):
+    """Arm a per-test all-thread stack dump at DVTPU_TEST_DUMP_S
+    (default 600s — no fast-tier test legitimately runs that long);
+    cancelled on normal completion so only a genuine wedge dumps."""
+    if _WEDGE_LOG is not None:
+        _WEDGE_LOG.write(f"# arming for {request.node.nodeid}\n")
+        _WEDGE_LOG.flush()
+        faulthandler.dump_traceback_later(
+            _TEST_DUMP_S, exit=False, file=_WEDGE_LOG)
+    yield
+    if _WEDGE_LOG is not None:
+        faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _wedge_log_cleanup():
+    yield
+    if _WEDGE_LOG is None:
+        return
+    faulthandler.cancel_dump_traceback_later()
+    _WEDGE_LOG.close()
+    try:
+        text = _WEDGE_LOG_PATH.read_text()
+        if "Timeout" not in text:  # only arm markers: clean session
+            _WEDGE_LOG_PATH.unlink()
+        else:
+            print(f"\n[watchdog] wedge stack dump(s) kept: "
+                  f"{_WEDGE_LOG_PATH}")
+    except OSError:
+        pass
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _threadcheck_session():
+    """When DVTPU_THREADCHECK=1: assert the session's observed
+    lock-acquisition graph is acyclic at teardown and export it
+    (DVTPU_THREADCHECK_EXPORT / DVTPU_TRACE_SPOOL dir /
+    logs/lockgraph-<pid>.json) — the runtime twin of `make
+    lint-threads`."""
+    yield
+    if _THREADCHECK is None:
+        return
+    from tools.jaxlint import threadcheck as tc
+
+    path = _THREADCHECK.export(tc.default_export_path())
+    print(f"\n[threadcheck] lock graph exported: {path}")
+    _THREADCHECK.check_acyclic()
 
 
 @pytest.fixture(scope="session")

@@ -1867,3 +1867,496 @@ def test_load_config_reads_span_funcs(tmp_path):
     assert cfg.span_funcs == ["run_compiled*"]
     # defaults share the JX111/JX112 step-call naming
     assert "*_train_step" in LintConfig().span_funcs
+
+
+# ------------------------------- concurrency tier (ISSUE 14, JX118-122)
+
+
+def test_jx118_flags_thread_shared_attr_without_lock(tmp_path):
+    r = lint(tmp_path, "lib/worker.py", """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self._count = 0
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                self._count = self._count + 1
+
+            def count(self):
+                return self._count
+        """)
+    assert codes(r) == ["JX118"]
+    assert "Collector._count" in r.findings[0].message
+    assert "_worker" in r.findings[0].message
+
+
+def test_jx118_passes_lock_guarded_and_queue_handoff(tmp_path):
+    r = lint(tmp_path, "lib/worker.py", """
+        import queue
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._q = queue.Queue()
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._lock:
+                    self._count += 1
+                self._q.put(1)          # queue handoff: sanctioned
+
+            def count(self):
+                with self._lock:
+                    return self._count
+
+            def drain(self):
+                return self._q.get(timeout=1)
+        """)
+    assert codes(r) == []
+
+
+def test_jx118_flags_public_side_unlocked(tmp_path):
+    # the thread writes under the lock but the public reader doesn't:
+    # EITHER side outside the lock is the hazard
+    r = lint(tmp_path, "lib/worker.py", """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._lock:
+                    self._state["k"] = 1
+
+            def snapshot(self):
+                return dict(self._state)
+        """)
+    assert codes(r) == ["JX118"]
+
+
+def test_jx118_nested_def_thread_target(tmp_path):
+    # target= a nested def of the method: its closure body is
+    # thread-side too
+    r = lint(tmp_path, "lib/worker.py", """
+        import threading
+
+        class Booter:
+            def __init__(self):
+                self.ready = False
+
+            def launch(self):
+                def boot():
+                    self.ready = True
+
+                threading.Thread(target=boot).start()
+
+            def is_ready(self):
+                return self.ready
+        """)
+    assert codes(r) == ["JX118"]
+
+
+def test_jx119_flags_blocking_calls_under_lock(tmp_path):
+    r = lint(tmp_path, "lib/svc.py", """
+        import threading
+        import time
+        from urllib.request import urlopen
+
+        _LOCK = threading.Lock()
+
+        def refresh(q, url):
+            with _LOCK:
+                body = urlopen(url).read()
+                item = q.get()
+                time.sleep(0.5)
+            return body, item
+        """)
+    assert codes(r) == ["JX119", "JX119", "JX119"]
+    assert "network round-trip" in r.findings[0].message
+    assert "queue.get()" in r.findings[1].message
+
+
+def test_jx119_passes_bounded_and_lock_free(tmp_path):
+    r = lint(tmp_path, "lib/svc.py", """
+        import threading
+        from urllib.request import urlopen
+
+        _LOCK = threading.Lock()
+
+        def refresh(q, url, names):
+            with _LOCK:
+                item = q.get(timeout=1.0)    # bounded: fine
+                label = ",".join(names)      # str.join has an arg
+            body = urlopen(url).read()       # outside the lock
+            return body, item, label
+        """)
+    assert codes(r) == []
+
+
+def test_jx119_interprocedural_helper_block(tmp_path):
+    # the I/O hides inside a helper: the project blocking summary
+    # reaches through the call
+    r = lint(tmp_path, "lib/svc.py", """
+        import threading
+        from urllib.request import urlopen
+
+        _LOCK = threading.Lock()
+
+        def _fetch(url):
+            return urlopen(url).read()
+
+        def refresh(url):
+            with _LOCK:
+                return _fetch(url)
+        """)
+    assert codes(r) == ["JX119"]
+    assert "_fetch" in r.findings[0].message
+
+
+def test_jx119_lock_blocking_calls_knob_overrides(tmp_path):
+    cfg = LintConfig(lock_blocking_calls=["*.slow_rpc"])
+    r = lint(tmp_path, "lib/svc.py", """
+        import threading
+        from urllib.request import urlopen
+
+        _LOCK = threading.Lock()
+
+        def refresh(client, url):
+            with _LOCK:
+                a = client.slow_rpc()        # matched by the knob
+                b = urlopen(url)             # NOT matched now
+            return a, b
+        """, cfg=cfg)
+    assert codes(r) == ["JX119"]
+
+
+def test_jx120_flags_abba_cycle(tmp_path):
+    r = lint(tmp_path, "lib/pair.py", """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def forward():
+            with _A:
+                with _B:
+                    pass
+
+        def backward():
+            with _B:
+                with _A:
+                    pass
+        """)
+    assert codes(r) == ["JX120"]
+    assert "cycle" in r.findings[0].message
+
+
+def test_jx120_passes_consistent_order(tmp_path):
+    r = lint(tmp_path, "lib/pair.py", """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def forward():
+            with _A:
+                with _B:
+                    pass
+
+        def also_forward():
+            with _A:
+                with _B:
+                    pass
+        """)
+    assert codes(r) == []
+
+
+def test_jx120_cycle_through_call_chain(tmp_path):
+    # f holds A and calls g which takes B; h holds B and calls k which
+    # takes A — the cycle only exists through the call graph
+    r = lint(tmp_path, "lib/pair.py", """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def take_b():
+            with _B:
+                pass
+
+        def take_a():
+            with _A:
+                pass
+
+        def f():
+            with _A:
+                take_b()
+
+        def h():
+            with _B:
+                take_a()
+        """)
+    assert codes(r) == ["JX120"]
+
+
+def test_jx120_flags_lock_across_collective(tmp_path):
+    r = lint(tmp_path, "lib/sync.py", """
+        import threading
+        from jax.experimental.multihost_utils import sync_global_devices
+
+        _LOCK = threading.Lock()
+
+        def commit(tag):
+            with _LOCK:
+                sync_global_devices(tag, timeout_in_ms=60000)
+        """)
+    assert codes(r) == ["JX120"]
+    assert "collective" in r.findings[0].message
+
+
+def test_jx120_flags_flock_across_collective(tmp_path):
+    # the PR 8 hazard class: an fcntl.flock held (no `with` scope to
+    # see through) when the function reaches a cross-host barrier
+    r = lint(tmp_path, "lib/sync.py", """
+        import fcntl
+        from jax.experimental.multihost_utils import sync_global_devices
+
+        def commit(fd, tag):
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            sync_global_devices(tag, timeout_in_ms=60000)
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        """)
+    assert codes(r) == ["JX120"]
+    assert "flock-across-collective" in r.findings[0].message
+
+
+def test_jx120_passes_flock_released_before_collective(tmp_path):
+    r = lint(tmp_path, "lib/sync.py", """
+        import fcntl
+        from jax.experimental.multihost_utils import sync_global_devices
+
+        def commit(fd, tag):
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            sync_global_devices(tag, timeout_in_ms=60000)
+        """)
+    assert codes(r) == []
+
+
+def test_jx121_flags_fork_pool_in_jax_module(tmp_path):
+    r = lint(tmp_path, "lib/feed.py", """
+        import multiprocessing as mp
+
+        import jax
+
+        def launch(n):
+            return mp.Pool(n)
+        """)
+    assert codes(r) == ["JX121"]
+    assert "spawn" in r.findings[0].message
+
+
+def test_jx121_passes_spawn_context_and_jax_free(tmp_path):
+    r = lint(tmp_path, "lib/feed.py", """
+        import multiprocessing as mp
+
+        import jax
+
+        def launch(n):
+            ctx = mp.get_context("spawn")
+            return ctx.Pool(n), mp.get_context("spawn").Queue()
+        """)
+    assert codes(r) == []
+    # no jax/tf anywhere near: fork is the caller's business
+    r = lint(tmp_path, "lib/plain.py", """
+        import multiprocessing as mp
+
+        def launch(n):
+            return mp.Pool(n)
+        """)
+    assert codes(r) == []
+
+
+def test_jx121_transitive_import_reaches_jax(tmp_path):
+    # b.py never imports jax itself — but it imports a.py, which does:
+    # the forked child still inherits the runtime's locked mutexes
+    pa = tmp_path / "lib" / "a.py"
+    pb = tmp_path / "lib" / "b.py"
+    pa.parent.mkdir(parents=True, exist_ok=True)
+    pa.write_text(textwrap.dedent("""
+        import jax
+
+        def model():
+            return jax.numpy.zeros(3)
+        """))
+    pb.write_text(textwrap.dedent("""
+        import multiprocessing as mp
+
+        from lib.a import model
+
+        def launch(n):
+            return mp.Pool(n)
+        """))
+    cfg = LintConfig(traced_dirs=["traced"], data_dirs=["data"],
+                     parallel_dirs=["parallel"])
+    r = run_paths([pa, pb], cfg, root=tmp_path)
+    assert codes(r) == ["JX121"]
+    assert r.findings[0].path == "lib/b.py"
+
+
+def test_jx122_flags_lock_and_io_in_handler(tmp_path):
+    r = lint(tmp_path, "lib/sig.py", """
+        import signal
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def _on_term(signum, frame):
+            with _LOCK:
+                pass
+
+        def _on_usr1(signum, frame):
+            open("/tmp/marker", "w").write("hit")
+
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGUSR1, _on_usr1)
+        """)
+    assert codes(r) == ["JX122", "JX122"]
+    assert "acquires lock" in r.findings[0].message
+
+
+def test_jx122_bare_dump_is_not_vetted(tmp_path):
+    # the vetted-path knob matches the FULL dotted name: json.dump in
+    # a handler is exactly the non-atomic I/O JX122 exists to flag,
+    # and must not ride the flight-recorder "dump" exemption
+    r = lint(tmp_path, "lib/sig.py", """
+        import json
+        import signal
+
+        _STATE = {"n": 0}
+
+        def _on_term(signum, frame):
+            with open("/tmp/state.json", "w") as fh:
+                json.dump(_STATE, fh)
+
+        signal.signal(signal.SIGTERM, _on_term)
+        """)
+    assert codes(r) == ["JX122"]
+
+
+def test_jx122_passes_flag_flip_and_vetted_dump(tmp_path):
+    r = lint(tmp_path, "lib/sig.py", """
+        import signal
+
+        _FIRED = {"stop": False}
+
+        def _on_term(signum, frame):
+            _FIRED["stop"] = True
+
+        def _on_usr1(signum, frame):
+            from deepvision_tpu.obs.distributed import flight_dump
+
+            flight_dump(f"signal-{signum}")   # the vetted black box
+            raise SystemExit(143)
+
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGUSR1, _on_usr1)
+        """)
+    assert codes(r) == []
+
+
+def test_jx122_transitive_hazard_through_helper(tmp_path):
+    r = lint(tmp_path, "lib/sig.py", """
+        import signal
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def _publish():
+            with _LOCK:
+                pass
+
+        def _on_term(signum, frame):
+            _publish()
+
+        signal.signal(signal.SIGTERM, _on_term)
+        """)
+    assert codes(r) == ["JX122"]
+    assert "_publish" in r.findings[0].message
+
+
+def test_jx122_method_handler_resolves(tmp_path):
+    r = lint(tmp_path, "lib/sig.py", """
+        import signal
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                signal.signal(signal.SIGTERM, self._on_term)
+
+            def _on_term(self, signum, frame):
+                with self._lock:
+                    pass
+        """)
+    assert codes(r) == ["JX122"]
+
+
+def test_load_config_reads_concurrency_knobs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        lock_name_patterns = ["*guard*"]
+        lock_blocking_calls = ["*.slow_rpc"]
+        collective_calls = ["*fleet_barrier*"]
+        fork_unsafe_imports = ["torch"]
+        signal_safe_calls = ["blackbox_dump"]
+        """))
+    cfg = load_config(p)
+    assert cfg.lock_name_patterns == ["*guard*"]
+    assert cfg.lock_blocking_calls == ["*.slow_rpc"]
+    assert cfg.collective_calls == ["*fleet_barrier*"]
+    assert cfg.fork_unsafe_imports == ["torch"]
+    assert cfg.signal_safe_calls == ["blackbox_dump"]
+    # defaults encode the repo's hazards
+    d = LintConfig()
+    assert "*lock*" in d.lock_name_patterns
+    assert "time.sleep" in d.lock_blocking_calls
+    assert "sync_global_devices" in d.collective_calls
+    assert "jax" in d.fork_unsafe_imports
+    assert "flight_dump" in d.signal_safe_calls
+
+
+def test_jx118_lock_name_patterns_knob(tmp_path):
+    # a bespoke guard-attribute name satisfies JX118 once the knob
+    # names it as a lock pattern
+    src = """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self._guard = threading.Lock()
+                self._count = 0
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._guard:
+                    self._count += 1
+
+            def count(self):
+                with self._guard:
+                    return self._count
+        """
+    assert codes(lint(tmp_path, "lib/w.py", src)) == []  # factory-typed
+    cfg = LintConfig(lock_name_patterns=["*guard*"])
+    assert codes(lint(tmp_path, "lib/w2.py", src, cfg=cfg)) == []

@@ -1421,3 +1421,9 @@ class SpanSyncChecker(Checker):
                     and ctx.func.attr == "span":
                 return ctx
         return None
+
+
+# concurrency tier (JX118-JX122, ISSUE 14): importing for registration
+# side effects keeps every "import checkers" site (run_paths, the CLI)
+# seeing the full checker set
+import tools.jaxlint.concurrency  # noqa: E402,F401  (registration)

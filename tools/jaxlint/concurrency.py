@@ -1,0 +1,1181 @@
+"""jaxlint concurrency tier: lock discipline for the host-side runtime.
+
+The serving fleet, the resilience tier, the input pipeline, and the obs
+stack together hold ~30 locks, a dozen long-lived threads, several
+signal handlers, and two multiprocessing pools — and until ISSUE 14
+every hard-won rule about them (spawn-not-fork after jax/tf init, no
+flock across a collective, no blocking I/O under a hot lock,
+stop-event-not-sleep) lived only in CHANGES.md prose. These five
+checkers ride the PR 9 :class:`~tools.jaxlint.core.ProjectContext`
+interprocedural dataflow so the rules hold through helper calls and
+module boundaries:
+
+- **JX118 unguarded shared state** — an instance attribute mutated by a
+  ``threading.Thread``-target method (or anything it transitively calls
+  on the same class) and read/written from a public method, with either
+  side outside the instance's lock. Resolved per class;
+  ``with self._lock:`` scopes, lock/queue/event/future-typed attributes,
+  and thread-local handoffs are recognized as safe.
+- **JX119 blocking call under lock** — HTTP round-trips, subprocess
+  waits, unbounded ``queue.get()``/``.join()``/``.wait()``, file I/O,
+  and sleeps inside a ``with <lock>:`` body; via the project callable
+  summaries, a call to a helper that *transitively* blocks is the same
+  hazard routed through a function boundary. Every other thread that
+  wants the lock stalls behind the I/O — the class of bug that turned
+  the obs registry and the router probe loop into convoy points.
+- **JX120 lock-order graph** — a project-wide lock-acquisition digraph
+  from nested ``with lock:`` scopes plus calls that (transitively)
+  acquire; any cycle is a potential ABBA deadlock, reported once per
+  cycle. A second rule in the same checker rediscovers the PR 8 hazard
+  class: ANY lock held across a cross-host collective/barrier call is
+  an implicit cycle through the barrier (a peer blocked at the barrier
+  may need the lock — exactly why the Trainer's cluster save is
+  lock-free).
+- **JX121 fork-safety** — ``multiprocessing`` ``Pool``/``Process``/
+  ``Queue`` created without an explicit spawn context in a module that
+  (directly or through the project import graph) reaches jax/tf: a
+  forked child inherits the runtime's locked mutexes with no owner
+  thread and wedges on first use — the PR 2 tier-1 deadlock, codified.
+- **JX122 signal-handler safety** — functions registered via
+  ``signal.signal`` that acquire locks, allocate through the metrics
+  registry, or perform non-atomic I/O (directly or transitively): a
+  handler can interrupt its own process MID-CRITICAL-SECTION and
+  self-deadlock on the very lock it tries to take. The vetted
+  flight-recorder dump path (``signal_safe_calls`` knob) is exempt —
+  it is written to be best-effort and never to raise.
+
+Knobs (``jaxlint.toml [jaxlint]``): ``lock_name_patterns``,
+``lock_blocking_calls``, ``collective_calls``, ``fork_unsafe_imports``,
+``signal_safe_calls``. The runtime twin of this static tier is
+``tools/jaxlint/threadcheck.py`` — an instrumented-lock sanitizer that
+records the LIVE acquisition graph and asserts acyclicity.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Iterator
+
+from tools.jaxlint.core import (
+    Checker,
+    Finding,
+    FunctionInfo,
+    FunctionNode,
+    ModuleContext,
+    assign_target_names,
+    call_name,
+    dotted_name,
+    iter_own_nodes,
+    last_attr,
+    register_checker,
+)
+
+# factories whose result is a mutex-like object (lock identity + kind)
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+# factories whose result is a thread-safe handoff/sync object: an
+# attribute of one of these types is a SANCTIONED cross-thread channel,
+# not unguarded shared state (JX118)
+_SAFE_FACTORIES = _LOCK_FACTORIES | {
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "Event",
+    "Barrier", "Future", "deque", "local",
+}
+_MP_CLASSES = {"Pool", "Process", "Queue", "SimpleQueue",
+               "JoinableQueue", "Manager"}
+_SPAWN_METHODS = {"spawn", "forkserver"}
+# registry get-or-create API: allocation takes the registry lock — a
+# handler interrupting mid-register self-deadlocks (JX122)
+_REGISTRY_ALLOC = {"counter", "gauge", "histogram", "register"}
+_HANDLER_IO = {"print", "open", "write", "write_text", "write_bytes",
+               "read_text", "read_bytes", "flush"}
+
+
+def _lockish(name: str | None, patterns) -> bool:
+    if not name:
+        return False
+    n = name.lower()
+    return any(fnmatch.fnmatch(n, p.lower()) for p in patterns)
+
+
+def _match_call(call: ast.Call, patterns) -> str | None:
+    """First pattern-matching name of a call, checked against both the
+    dotted call name and its final attribute (the JX115 convention)."""
+    cn = call_name(call)
+    la = last_attr(cn)
+    method = call.func.attr if isinstance(call.func, ast.Attribute) \
+        else None
+    for n in (cn, la, method):
+        if n and any(fnmatch.fnmatch(n, p) for p in patterns):
+            return n
+    return None
+
+
+def _self_attr(expr: ast.AST) -> str | None:
+    """'x' for a ``self.x`` attribute expression, else None."""
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id in ("self", "cls"):
+        return expr.attr
+    return None
+
+
+def _self_attr_stores(stmt: ast.stmt) -> list[ast.Attribute]:
+    """``self.X`` attribute nodes BOUND by an assignment statement."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    out = []
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.ctx, (ast.Store, ast.Del)) \
+                    and _self_attr(sub) is not None:
+                out.append(sub)
+            elif isinstance(sub, ast.Subscript):
+                # self.X[k] = ... mutates self.X (a Load of X on the
+                # receiver, but a WRITE of the shared structure)
+                recv = sub.value
+                if isinstance(recv, ast.Attribute) \
+                        and _self_attr(recv) is not None:
+                    out.append(recv)
+    return out
+
+
+def lock_scoped_nodes(func: FunctionNode, is_lock):
+    """Yield ``(node, held)`` for every node of ``func``'s own body
+    (nested defs/lambdas excluded — they run when called, not here),
+    where ``held`` is the tuple of lock tokens of the enclosing
+    ``with``-lock scopes. ``is_lock(expr)`` returns a truthy token
+    (identity) for lock expressions. ``With`` nodes yield with the
+    OUTER held set — the acquisition itself happens under what was
+    already held."""
+    out: list[tuple[ast.AST, tuple]] = []
+
+    def rec(node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        out.append((node, held))
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                rec(item.context_expr, held)
+                if item.optional_vars is not None:
+                    rec(item.optional_vars, held)
+                tok = is_lock(item.context_expr)
+                if tok:
+                    acquired.append(tok)
+            inner = held + tuple(acquired)
+            for stmt in node.body:
+                rec(stmt, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            rec(child, held)
+
+    for stmt in func.body:
+        rec(stmt, ())
+    return out
+
+
+# ------------------------------------------------------- per-class model
+
+
+class _ClassModel:
+    """Thread/lock structure of one class: its methods, which of them
+    run on a background thread (``threading.Thread(target=self._x)``
+    closures, nested-def targets included), its lock attributes, and
+    its thread-safe handoff attributes."""
+
+    def __init__(self, mod: ModuleContext, name: str,
+                 methods: list[FunctionInfo]):
+        self.mod = mod
+        self.name = name
+        self.methods = {m.node.name: m for m in methods}
+        patterns = mod.cfg.lock_name_patterns
+        self.lock_attrs: set[str] = set()
+        self.safe_attrs: set[str] = set()
+        nested: dict[int, list[FunctionInfo]] = {}
+        for f in mod.functions:
+            if f.parent is not None:
+                nested.setdefault(id(f.parent.node), []).append(f)
+        # attribute typing from assignments anywhere in the class
+        for info in methods:
+            for node in ast.walk(info.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = getattr(node, "value", None)
+                if not isinstance(value, ast.Call):
+                    continue
+                la = last_attr(call_name(value))
+                for attr_node in _self_attr_stores(node):
+                    if la in _LOCK_FACTORIES:
+                        self.lock_attrs.add(attr_node.attr)
+                    if la in _SAFE_FACTORIES:
+                        self.safe_attrs.add(attr_node.attr)
+        # thread entry points: Thread(target=self._x) / Thread(target=f)
+        # where f is a nested def of the enclosing method
+        entries: list[FunctionNode] = []
+        self.thread_targets: list[str] = []
+        for info in methods:
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if last_attr(call_name(node)) not in ("Thread", "Timer"):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    attr = _self_attr(kw.value)
+                    if attr and attr in self.methods:
+                        entries.append(self.methods[attr].node)
+                        self.thread_targets.append(attr)
+                    elif isinstance(kw.value, ast.Name):
+                        for g in nested.get(id(info.node), []):
+                            if g.node.name == kw.value.id:
+                                entries.append(g.node)
+                                self.thread_targets.append(kw.value.id)
+        # close over same-class self-calls + nested defs
+        thread_fns: set[int] = set()
+        work = list(entries)
+        while work:
+            fn = work.pop()
+            if id(fn) in thread_fns:
+                continue
+            thread_fns.add(id(fn))
+            for g in nested.get(id(fn), []):
+                work.append(g.node)
+            for node in iter_own_nodes(fn):
+                if isinstance(node, ast.Call):
+                    attr = _self_attr(node.func)
+                    if attr and attr in self.methods:
+                        work.append(self.methods[attr].node)
+        self.thread_fn_ids = thread_fns
+
+    def is_instance_lock(self, expr: ast.AST) -> str | None:
+        attr = _self_attr(expr)
+        if attr is None:
+            return None
+        if attr in self.lock_attrs \
+                or _lockish(attr, self.mod.cfg.lock_name_patterns):
+            return attr
+        return None
+
+
+def _classes_of(mod: ModuleContext) -> list[_ClassModel]:
+    groups: dict[str, list[FunctionInfo]] = {}
+    for f in mod.functions:
+        if f.parent is not None or "." not in f.qualname:
+            continue
+        groups.setdefault(f.qualname.rsplit(".", 1)[0], []).append(f)
+    return [_ClassModel(mod, name, infos)
+            for name, infos in sorted(groups.items())]
+
+
+# --------------------------------------------------- project-level facts
+
+
+class ConcurrencyFacts:
+    """Project-wide concurrency summaries, computed once per
+    ``run_paths`` invocation and cached on the ProjectContext:
+
+    - ``lock_blocking_ids`` — functions whose own body (transitively,
+      through resolvable calls) performs a lock-hostile blocking call
+      (the JX119 set);
+    - ``collective_ids`` — functions transitively performing a
+      cross-host collective/barrier call (JX120's flock rule);
+    - ``fn_acquires`` — per function, the set of lock identities it
+      (transitively) acquires via ``with``;
+    - ``fork_unsafe_mod_ids`` — modules reaching a jax/tf import
+      through the project import graph (JX121's gate);
+    - the project lock-order graph + its cycles (JX120).
+    """
+
+    def __init__(self, mods: list[ModuleContext], cfg, project):
+        self.mods = mods
+        self.cfg = cfg
+        self.project = project
+        self._attr_lock_owner: dict[str, list[tuple[ModuleContext, str,
+                                                    str]]] = {}
+        self._lock_kinds: dict[str, str] = {}
+        self._collect_lock_owners()
+        self.lock_blocking_ids = self._blocking_closure()
+        self.collective_ids = self._collective_closure()
+        self.fork_unsafe_mod_ids = self._fork_unsafe_mods()
+        self.fn_acquires = self._acquire_closure()
+        self.edges: dict[tuple[str, str], tuple[ModuleContext, ast.AST]] \
+            = {}
+        self.collective_holds: list[tuple[ModuleContext, ast.AST, str,
+                                          str]] = []
+        self._build_lock_graph()
+        self.cycles = self._find_cycles()
+
+    # -- lock identity ---------------------------------------------------
+    def _collect_lock_owners(self) -> None:
+        """attr name -> [(module, class, kind)] creating it as a lock,
+        so ``other._lock`` resolves when exactly one class owns that
+        attribute name project-wide."""
+        for m in self.mods:
+            for info in m.functions:
+                if info.parent is not None or "." not in info.qualname:
+                    continue
+                cls = info.qualname.rsplit(".", 1)[0]
+                for node in ast.walk(info.node):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    value = getattr(node, "value", None)
+                    if not isinstance(value, ast.Call):
+                        continue
+                    kind = last_attr(call_name(value))
+                    if kind not in _LOCK_FACTORIES:
+                        continue
+                    for attr_node in _self_attr_stores(node):
+                        entry = (m, cls, kind)
+                        owners = self._attr_lock_owner.setdefault(
+                            attr_node.attr, [])
+                        if (m.relpath, cls) not in [(o[0].relpath, o[1])
+                                                    for o in owners]:
+                            owners.append(entry)
+                        self._lock_kinds[
+                            f"{m.relpath}:{cls}.{attr_node.attr}"] = kind
+            # module-level locks
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and last_attr(call_name(node.value)) \
+                        in _LOCK_FACTORIES:
+                    for name in assign_target_names(node):
+                        self._lock_kinds[f"{m.relpath}:{name}"] = \
+                            last_attr(call_name(node.value))
+
+    def lock_kind(self, lock_id: str) -> str:
+        return self._lock_kinds.get(lock_id, "Lock")
+
+    def lock_id(self, m: ModuleContext, info: FunctionInfo | None,
+                expr: ast.AST) -> str | None:
+        """Project-stable identity for a lock expression, or None when
+        the expression is not lock-like / not resolvable. ``self.X``
+        resolves to the enclosing class; ``obj.X`` resolves when
+        exactly one class creates ``X`` as a lock; bare names resolve
+        module- or function-scoped."""
+        patterns = m.cfg.lock_name_patterns
+        attr = _self_attr(expr)
+        if attr is not None:
+            cls = _enclosing_class(info)
+            if cls is None:
+                return None
+            lid = f"{m.relpath}:{cls}.{attr}"
+            if lid in self._lock_kinds or _lockish(attr, patterns):
+                return lid
+            return None
+        if isinstance(expr, ast.Attribute):
+            owners = self._attr_lock_owner.get(expr.attr, [])
+            if len(owners) == 1:
+                om, cls, _ = owners[0]
+                return f"{om.relpath}:{cls}.{expr.attr}"
+            return None  # ambiguous/unknown receiver: stay silent
+        if isinstance(expr, ast.Name):
+            lid = f"{m.relpath}:{expr.id}"
+            if lid in self._lock_kinds:
+                return lid  # a known factory-created module lock
+            if not _lockish(expr.id, patterns):
+                return None
+            if info is not None:
+                return f"{m.relpath}:{info.qualname}.{expr.id}"
+            return lid
+        return None
+
+    # -- callable summaries ----------------------------------------------
+    def _own_blocking_call(self, node: ast.Call) -> str | None:
+        reason = blocking_reason(node, self.cfg)
+        return reason
+
+    def _blocking_closure(self) -> set[int]:
+        ids: set[int] = set()
+        for m in self.mods:
+            for info in m.functions:
+                for node in iter_own_nodes(info.node):
+                    if isinstance(node, ast.Call) \
+                            and blocking_reason(node, self.cfg):
+                        ids.add(id(info.node))
+                        break
+        return self._close_over_calls(ids)
+
+    def _collective_closure(self) -> set[int]:
+        ids: set[int] = set()
+        patterns = self.cfg.collective_calls
+        for m in self.mods:
+            for info in m.functions:
+                for node in iter_own_nodes(info.node):
+                    if isinstance(node, ast.Call) \
+                            and _match_call(node, patterns):
+                        ids.add(id(info.node))
+                        break
+        return self._close_over_calls(ids)
+
+    def _close_over_calls(self, ids: set[int]) -> set[int]:
+        if self.project is None:
+            return ids
+        callees = self.project._callees
+        changed = True
+        while changed:
+            changed = False
+            for fid, fns in callees.items():
+                if fid in ids:
+                    continue
+                if any(id(fn) in ids for fn in fns):
+                    ids.add(fid)
+                    changed = True
+        return ids
+
+    def resolve(self, m: ModuleContext, call: ast.Call,
+                within: FunctionInfo | None = None):
+        if self.project is None:
+            return []
+        return self.project.resolve_call(m, call, within)
+
+    # -- fork-unsafe module closure --------------------------------------
+    def _fork_unsafe_mods(self) -> set[int]:
+        roots = set(self.cfg.fork_unsafe_imports)
+
+        def direct(m: ModuleContext) -> bool:
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Import):
+                    if any(a.name.split(".")[0] in roots
+                           for a in node.names):
+                        return True
+                elif isinstance(node, ast.ImportFrom) and node.module \
+                        and not node.level:
+                    if node.module.split(".")[0] in roots:
+                        return True
+            return False
+
+        unsafe = {id(m) for m in self.mods if direct(m)}
+        if self.project is None:
+            return unsafe
+        by_modname = self.project.by_modname
+        deps: dict[int, set[int]] = {}
+        for m in self.mods:
+            targets = set()
+            for imp in self.project._imports[id(m)].values():
+                modname = imp[1]
+                tm = by_modname.get(modname)
+                if tm is not None:
+                    targets.add(id(tm))
+                if imp[0] == "sym":
+                    tm = by_modname.get(f"{imp[1]}.{imp[2]}")
+                    if tm is not None:
+                        targets.add(id(tm))
+            deps[id(m)] = targets
+        changed = True
+        while changed:
+            changed = False
+            for m in self.mods:
+                if id(m) in unsafe:
+                    continue
+                if deps[id(m)] & unsafe:
+                    unsafe.add(id(m))
+                    changed = True
+        return unsafe
+
+    # -- acquisition graph ----------------------------------------------
+    def _acquire_closure(self) -> dict[int, set[str]]:
+        acquires: dict[int, set[str]] = {}
+        for m in self.mods:
+            for info in m.functions:
+                direct: set[str] = set()
+                is_lock = lambda e, m=m, info=info: \
+                    self.lock_id(m, info, e)  # noqa: E731
+                for node, _held in lock_scoped_nodes(info.node, is_lock):
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for item in node.items:
+                            tok = self.lock_id(m, info, item.context_expr)
+                            if tok:
+                                direct.add(tok)
+                acquires[id(info.node)] = direct
+        if self.project is not None:
+            callees = self.project._callees
+            changed = True
+            while changed:
+                changed = False
+                for fid, fns in callees.items():
+                    cur = acquires.get(fid, set())
+                    for fn in fns:
+                        extra = acquires.get(id(fn), set()) - cur
+                        if extra:
+                            cur |= extra
+                            acquires[fid] = cur
+                            changed = True
+        return acquires
+
+    def _build_lock_graph(self) -> None:
+        coll_patterns = self.cfg.collective_calls
+        for m in self.mods:
+            for info in m.functions:
+                is_lock = lambda e, m=m, info=info: \
+                    self.lock_id(m, info, e)  # noqa: E731
+                for node, held in lock_scoped_nodes(info.node, is_lock):
+                    if not held:
+                        continue
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for item in node.items:
+                            tok = self.lock_id(m, info, item.context_expr)
+                            if tok:
+                                for h in held:
+                                    self._edge(h, tok, m, node)
+                        continue
+                    if not isinstance(node, ast.Call):
+                        continue
+                    la = last_attr(call_name(node))
+                    if la in ("acquire", "release"):
+                        continue
+                    # a collective under ANY held lock: the implicit
+                    # cycle through the barrier (PR 8 hazard class)
+                    coll = _match_call(node, coll_patterns)
+                    if coll is None:
+                        for fn in self.resolve(m, node, info):
+                            if id(fn) in self.collective_ids:
+                                coll = fn.name
+                                break
+                    if coll is not None:
+                        self.collective_holds.append(
+                            (m, node, held[-1], coll))
+                        continue
+                    for fn in self.resolve(m, node, info):
+                        for tok in self.fn_acquires.get(id(fn), ()):
+                            for h in held:
+                                self._edge(h, tok, m, node)
+
+    def _edge(self, a: str, b: str, m: ModuleContext,
+              node: ast.AST) -> None:
+        if a == b and self.lock_kind(a) == "RLock":
+            return  # reentrant re-acquire is the point of an RLock
+        self.edges.setdefault((a, b), (m, node))
+
+    def _find_cycles(self) -> list[tuple[list[str], ModuleContext,
+                                         ast.AST]]:
+        """Cycles in the acquisition digraph, one per SCC (plus
+        self-loops), each attributed to its first recorded edge site."""
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            # iterative Tarjan (lock graphs are small; recursion depth
+            # is still bounded defensively)
+            work = [(v, iter(sorted(graph[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        out = []
+        for scc in sccs:
+            nodes = sorted(scc)
+            cyclic = len(nodes) > 1 or (
+                len(nodes) == 1 and (nodes[0], nodes[0]) in self.edges)
+            if not cyclic:
+                continue
+            sites = [(self.edges[(a, b)], (a, b))
+                     for a in nodes for b in nodes
+                     if (a, b) in self.edges]
+            sites.sort(key=lambda s: (s[0][0].relpath,
+                                      getattr(s[0][1], "lineno", 0)))
+            (m, node), _ = sites[0]
+            out.append((nodes, m, node))
+        return out
+
+
+def _enclosing_class(info: FunctionInfo | None) -> str | None:
+    if info is None:
+        return None
+    chain = 1
+    p = info.parent
+    while p is not None:
+        chain += 1
+        p = p.parent
+    parts = info.qualname.split(".")
+    prefix = parts[:-chain]
+    return ".".join(prefix) if prefix else None
+
+
+def blocking_reason(call: ast.Call, cfg) -> str | None:
+    """Why ``call`` blocks the calling thread unboundedly (the JX119
+    predicate), or None. Pattern knob + structural rules: zero-arg
+    ``.get()``/``.join()``/``.wait()`` are unbounded (a timeout bounds
+    them; ``str.join(iterable)`` has an argument), bare ``sleep`` rides
+    the time.sleep rule."""
+    la = last_attr(call_name(call))
+    method = call.func.attr if isinstance(call.func, ast.Attribute) \
+        else None
+    name = _match_call(call, cfg.lock_blocking_calls)
+    if name is not None:
+        return f"'{name}' ({_io_kind(name)})"
+    if isinstance(call.func, ast.Name) and call.func.id == "sleep":
+        return "'sleep' (time.sleep)"
+    eff = la or method
+    if eff in ("get", "join", "wait"):
+        has_timeout = any(k.arg and "timeout" in k.arg.lower()
+                          for k in call.keywords)
+        blocking_kw = any(k.arg == "block" for k in call.keywords)
+        if eff == "get":
+            if not call.args and not call.keywords:
+                return "unbounded 'queue.get()'"
+            if blocking_kw and not has_timeout:
+                return "unbounded 'queue.get(block=True)'"
+            return None
+        if call.args or call.keywords:
+            return None  # join(timeout)/wait(timeout)/str.join(parts)
+        return f"unbounded '.{eff}()'"
+    return None
+
+
+def _io_kind(name: str) -> str:
+    n = name.lower()
+    if "url" in n or "request" in n or "recv" in n or "accept" in n \
+            or "connect" in n or "getresponse" in n:
+        return "network round-trip"
+    if "subprocess" in n or "communicate" in n:
+        return "subprocess wait"
+    if "sleep" in n:
+        return "sleep"
+    return "file I/O"
+
+
+def _facts_for(mod: ModuleContext) -> ConcurrencyFacts:
+    proj = mod.project
+    if proj is None:
+        return ConcurrencyFacts([mod], mod.cfg, None)
+    cached = getattr(proj, "_concurrency_facts", None)
+    if cached is None:
+        cached = ConcurrencyFacts(proj.mods, mod.cfg, proj)
+        proj._concurrency_facts = cached
+    return cached
+
+
+# ------------------------------------------------------------- checkers
+
+
+@register_checker
+class UnguardedSharedStateChecker(Checker):
+    """JX118: instance state shared between a background thread and the
+    public surface with no lock on at least one side. The GIL makes
+    single attribute loads atomic, not CONSISTENT: a public reader can
+    observe a half-updated pair of attributes, a stale list the thread
+    just swapped out, or a dict mid-mutation (RuntimeError under
+    iteration) — the class of bug pytest only catches when the
+    interleaving loses the lottery."""
+
+    code = "JX118"
+    name = "unguarded-shared-state"
+    description = ("instance attribute mutated by a Thread-target "
+                   "method and accessed from a public method with "
+                   "either side outside the instance lock")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        for cls in _classes_of(mod):
+            if not cls.thread_fn_ids:
+                continue
+            yield from self._check_class(mod, cls)
+
+    def _check_class(self, mod: ModuleContext,
+                     cls: _ClassModel) -> Iterator[Finding]:
+        is_lock = cls.is_instance_lock
+        # thread-side writes: attr -> [(node, locked)]
+        writes: dict[str, list[tuple[ast.AST, bool]]] = {}
+        for info in cls.methods.values():
+            fns = [info.node] + [f.node for f in mod.functions
+                                 if f.parent is not None
+                                 and self._under(f, info)]
+            for fn in fns:
+                if id(fn) not in cls.thread_fn_ids:
+                    continue
+                for node, held in lock_scoped_nodes(fn, is_lock):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                             ast.AugAssign)):
+                        continue
+                    for attr_node in _self_attr_stores(node):
+                        writes.setdefault(attr_node.attr, []).append(
+                            (node, bool(held)))
+        if not writes:
+            return
+        # public-surface accesses: attr -> [(node, locked, method)]
+        accesses: dict[str, list[tuple[ast.AST, bool, str]]] = {}
+        for name, info in cls.methods.items():
+            if name.startswith("_"):
+                continue
+            if id(info.node) in cls.thread_fn_ids:
+                continue
+            for node, held in lock_scoped_nodes(info.node, is_lock):
+                attr = _self_attr(node) if isinstance(
+                    node, ast.Attribute) else None
+                if attr is None or attr not in writes:
+                    continue
+                accesses.setdefault(attr, []).append(
+                    (node, bool(held), name))
+        for attr in sorted(accesses):
+            if attr in cls.safe_attrs or attr in cls.lock_attrs:
+                continue
+            w = writes[attr]
+            a = accesses[attr]
+            unlocked = [(n, meth) for n, locked, meth in a
+                        if not locked]
+            thread_unlocked = any(not locked for _n, locked in w)
+            if not unlocked and not thread_unlocked:
+                continue  # both sides consistently locked
+            node, meth = unlocked[0] if unlocked else (
+                a[0][0], a[0][2])
+            target = cls.thread_targets[0] if cls.thread_targets \
+                else "?"
+            side = ("public method" if unlocked
+                    else "thread-side write in")
+            yield mod.finding(
+                node, self.code,
+                f"'{cls.name}.{attr}' is mutated by the "
+                f"'{target}' thread and accessed from public method "
+                f"'{meth}' with the {side} outside the instance "
+                "lock — a reader can observe torn/stale state; hold "
+                "the instance's lock on both sides (or hand off "
+                "through a Queue/Event)")
+
+    @staticmethod
+    def _under(f: FunctionInfo, ancestor: FunctionInfo) -> bool:
+        p = f.parent
+        while p is not None:
+            if p is ancestor:
+                return True
+            p = p.parent
+        return False
+
+
+@register_checker
+class BlockingUnderLockChecker(Checker):
+    """JX119: a blocking call inside a ``with <lock>:`` body convoys
+    every thread that wants the lock behind the I/O — a wedged HTTP
+    peer or a slow disk turns one lock into a process-wide stall (and
+    under the obs registry lock, into a frozen /metrics surface exactly
+    when the incident needs it). Interprocedural: a call to a helper
+    that transitively blocks (project callable summary) is the same
+    hazard routed through a function boundary."""
+
+    code = "JX119"
+    name = "blocking-call-under-lock"
+    description = ("HTTP/subprocess/file-I/O/sleep or unbounded "
+                   "get()/join()/wait(), direct or routed through a "
+                   "helper, inside a `with lock:` body")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        facts = _facts_for(mod)
+        patterns = mod.cfg.lock_name_patterns
+        is_lock = lambda e: _is_lock_pattern_expr(e, patterns)  # noqa: E731
+        flagged: set[int] = set()
+        for info in mod.functions:
+            for node, held in lock_scoped_nodes(info.node, is_lock):
+                if not held or not isinstance(node, ast.Call) \
+                        or id(node) in flagged:
+                    continue
+                la = last_attr(call_name(node))
+                if la in ("acquire", "release"):
+                    continue  # nested acquisition is JX120's domain
+                reason = blocking_reason(node, mod.cfg)
+                if reason is not None:
+                    flagged.add(id(node))
+                    yield mod.finding(
+                        node, self.code,
+                        f"{reason} while holding '{held[-1]}': every "
+                        "thread wanting the lock stalls behind the "
+                        "blocking call; move the I/O outside the "
+                        "critical section (snapshot under the lock, "
+                        "act after releasing)")
+                    continue
+                for fn in facts.resolve(mod, node, info):
+                    if id(fn) in facts.lock_blocking_ids:
+                        flagged.add(id(node))
+                        yield mod.finding(
+                            node, self.code,
+                            f"'{call_name(node) or fn.name}' "
+                            f"transitively blocks (helper '{fn.name}' "
+                            "performs HTTP/subprocess/file I/O or an "
+                            "unbounded get/join/wait) while holding "
+                            f"'{held[-1]}'; move the blocking work "
+                            "outside the critical section")
+                        break
+
+
+def _is_lock_pattern_expr(expr: ast.AST, patterns) -> str | None:
+    if isinstance(expr, ast.Attribute) and _lockish(expr.attr, patterns):
+        return expr.attr
+    if isinstance(expr, ast.Name) and _lockish(expr.id, patterns):
+        return expr.id
+    return None
+
+
+@register_checker
+class LockOrderChecker(Checker):
+    """JX120: the project-wide lock-acquisition digraph. Nested
+    ``with lock:`` scopes and calls that (transitively) acquire add
+    edges held->acquired; a cycle means two call paths take the same
+    locks in opposite orders — the classic ABBA deadlock that only
+    fires under production interleavings. A second rule flags ANY lock
+    held across a cross-host collective/barrier call: the barrier
+    waits for peers, a peer may be blocked on the lock, and the
+    implicit cycle through the barrier wedges the fleet — the PR 8
+    flock-across-collective hazard class, now enforced."""
+
+    code = "JX120"
+    name = "lock-order-cycle"
+    description = ("cycle in the project lock-acquisition graph "
+                   "(potential ABBA deadlock), or a lock held across "
+                   "a cross-host collective/barrier")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        facts = _facts_for(mod)
+        for nodes, m, node in facts.cycles:
+            if m is not mod:
+                continue
+            path = " -> ".join(nodes + [nodes[0]]) if len(nodes) > 1 \
+                else f"{nodes[0]} -> {nodes[0]}"
+            yield mod.finding(
+                node, self.code,
+                f"lock-order cycle: {path} — these locks are acquired "
+                "in inconsistent order somewhere in the project, a "
+                "potential ABBA deadlock; impose one global order (or "
+                "collapse to a single lock)")
+        for m, node, lock, coll in facts.collective_holds:
+            if m is not mod:
+                continue
+            yield mod.finding(
+                node, self.code,
+                f"collective/barrier '{coll}' called while holding "
+                f"'{lock}': peers blocked at the barrier may need the "
+                "lock (the PR 8 flock-across-collective deadlock); "
+                "release the lock before any cross-host rendezvous")
+        # flock/acquire held positionally across a collective in the
+        # same function body (no `with` scope to see through)
+        facts_patterns = mod.cfg.lock_name_patterns
+        for info in mod.functions:
+            yield from self._flock_scan(mod, info, facts,
+                                        facts_patterns)
+
+    def _flock_scan(self, mod: ModuleContext, info: FunctionInfo,
+                    facts: ConcurrencyFacts,
+                    patterns) -> Iterator[Finding]:
+        acquires: list[int] = []
+        releases: list[int] = []
+        collectives: list[tuple[int, ast.AST, str]] = []
+        for node in iter_own_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            la = last_attr(cn)
+            if la == "flock" or (
+                    la == "acquire" and isinstance(
+                        node.func, ast.Attribute)
+                    and _is_lock_pattern_expr(node.func.value, patterns)):
+                if la == "flock" and _mentions_unlock(node):
+                    releases.append(node.lineno)
+                else:
+                    acquires.append(node.lineno)
+            elif la == "release" or (la == "flock"
+                                     and _mentions_unlock(node)):
+                releases.append(node.lineno)
+            else:
+                coll = _match_call(node, mod.cfg.collective_calls)
+                if coll is None:
+                    for fn in facts.resolve(mod, node, info):
+                        if id(fn) in facts.collective_ids:
+                            coll = fn.name
+                            break
+                if coll is not None:
+                    collectives.append((node.lineno, node, coll))
+        for line, node, coll in collectives:
+            held = [a for a in acquires if a < line
+                    and not any(a < r < line for r in releases)]
+            if held:
+                yield mod.finding(
+                    node, self.code,
+                    f"collective/barrier '{coll}' reached while a "
+                    "file/lock acquisition at line "
+                    f"{max(held)} is still held: a peer blocked at "
+                    "the barrier may need the same lock (the PR 8 "
+                    "flock-across-collective deadlock); release "
+                    "before the rendezvous")
+
+
+def _mentions_unlock(call: ast.Call) -> bool:
+    return any(isinstance(a, ast.AST) and "LOCK_UN" in (
+        dotted_name(a) or "") for a in call.args)
+
+
+@register_checker
+class ForkSafetyChecker(Checker):
+    """JX121: fork-based multiprocessing after jax/tf initialization.
+    Both runtimes start internal threads holding internal mutexes; a
+    ``fork()`` clones the locked mutex but not its owner thread, so the
+    child wedges the first time it touches the runtime — the PR 2
+    deadlock that froze tier-1 at test 39 until the 870s timeout. Any
+    ``Pool``/``Process``/``Queue`` in a module reaching a jax/tf import
+    (directly or through the project import graph) must come from an
+    explicit ``multiprocessing.get_context("spawn")``."""
+
+    code = "JX121"
+    name = "fork-after-jax-init"
+    description = ("multiprocessing Pool/Process/Queue without an "
+                   "explicit spawn context in a module that reaches a "
+                   "jax/tf import (the fork-after-init deadlock)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        facts = _facts_for(mod)
+        if id(mod) not in facts.fork_unsafe_mod_ids:
+            return
+        mp_aliases: set[str] = set()
+        direct: dict[str, str] = {}  # bare name -> mp class
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "multiprocessing":
+                        mp_aliases.add(a.asname or "multiprocessing")
+            elif isinstance(node, ast.ImportFrom) \
+                    and node.module == "multiprocessing":
+                for a in node.names:
+                    if a.name in _MP_CLASSES:
+                        direct[a.asname or a.name] = a.name
+        if not mp_aliases and not direct:
+            return
+        spawn_names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and getattr(node, "value", None) is not None \
+                    and self._is_spawn_ctx(node.value, mp_aliases):
+                spawn_names.update(assign_target_names(node))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MP_CLASSES:
+                recv = node.func.value
+                if isinstance(recv, ast.Name):
+                    if recv.id in spawn_names:
+                        continue  # ctx.Pool(...) through a spawn ctx
+                    if recv.id not in mp_aliases:
+                        continue  # some unrelated .Pool attribute
+                    cls = node.func.attr
+                elif isinstance(recv, ast.Call):
+                    if self._is_spawn_ctx(recv, mp_aliases):
+                        continue  # get_context("spawn").Pool(...)
+                    if last_attr(call_name(recv)) == "get_context":
+                        cls = node.func.attr  # fork/default context
+                    else:
+                        continue
+                else:
+                    continue
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in direct:
+                cls = direct[node.func.id]
+            if cls is None:
+                continue
+            yield mod.finding(
+                node, self.code,
+                f"multiprocessing.{cls} created without an explicit "
+                "spawn context in a module that reaches jax/tf: a "
+                "forked child inherits the runtime's locked mutexes "
+                "with no owner thread and deadlocks on first use "
+                "(the PR 2 tier-1 wedge); use "
+                "mp.get_context(\"spawn\")")
+
+    @staticmethod
+    def _is_spawn_ctx(expr: ast.AST, mp_aliases: set[str]) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        if last_attr(call_name(expr)) != "get_context":
+            return False
+        if not expr.args:
+            return False
+        arg = expr.args[0]
+        return isinstance(arg, ast.Constant) \
+            and arg.value in _SPAWN_METHODS
+
+
+@register_checker
+class SignalHandlerSafetyChecker(Checker):
+    """JX122: signal handlers run BETWEEN any two bytecodes of the
+    interrupted thread. A handler that takes a lock can interrupt the
+    critical section that already holds it (self-deadlock); one that
+    allocates through the metrics registry takes the registry lock the
+    interrupted scrape may hold; non-atomic I/O interleaves with the
+    interrupted stream. Handlers must flip flags/events and return —
+    the Trainer's ``request_preempt`` is the model. The vetted
+    flight-recorder dump path (``signal_safe_calls``) is exempt: it is
+    best-effort by construction and never raises."""
+
+    code = "JX122"
+    name = "unsafe-signal-handler"
+    description = ("signal.signal handler that acquires a lock, "
+                   "allocates registry metrics, or does non-atomic "
+                   "I/O (directly or transitively)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        facts = _facts_for(mod)
+        for info in list(mod.functions) + [None]:
+            tree = info.node if info is not None else mod.tree
+            nodes = iter_own_nodes(tree) if info is not None \
+                else self._module_level(mod)
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                if last_attr(call_name(node)) != "signal" \
+                        or len(node.args) < 2:
+                    continue
+                if not (call_name(node) or "").endswith(
+                        "signal.signal") and call_name(node) != "signal":
+                    continue
+                handler = node.args[1]
+                hazard = self._handler_hazard(mod, info, handler, facts)
+                if hazard is None:
+                    continue
+                hname, desc = hazard
+                yield mod.finding(
+                    node, self.code,
+                    f"signal handler '{hname}' {desc} — a handler "
+                    "interrupts its own process mid-critical-section "
+                    "and can self-deadlock or corrupt I/O; flip a "
+                    "flag/Event and do the work at a safe point "
+                    "(trainer.request_preempt is the model; the "
+                    "flight-recorder dump path is the vetted "
+                    "exception)")
+
+    @staticmethod
+    def _module_level(mod: ModuleContext):
+        fn_nodes = {id(f.node) for f in mod.functions}
+
+        def rec(node):
+            for child in ast.iter_child_nodes(node):
+                if id(child) in fn_nodes or isinstance(
+                        child, ast.Lambda):
+                    continue
+                yield child
+                yield from rec(child)
+
+        yield from rec(mod.tree)
+
+    def _handler_hazard(self, mod, info, handler, facts):
+        """(handler name, hazard description) or None."""
+        if isinstance(handler, ast.Lambda):
+            desc = self._fn_hazard_body(mod, info, handler, facts,
+                                        set(), 0)
+            return ("<lambda>", desc) if desc else None
+        ref = dotted_name(handler)
+        if ref in ("signal.SIG_DFL", "signal.SIG_IGN", "SIG_DFL",
+                   "SIG_IGN"):
+            return None
+        if ref is None:
+            return None
+        fns = []
+        if mod.project is not None:
+            fns = mod.project.resolve_name(mod, ref, info)
+        if not fns:
+            attr = last_attr(ref)
+            fns = [f.node for f in mod.functions
+                   if f.node.name == attr]
+        for fn in fns:
+            desc = self._fn_hazard(mod, fn, facts, set(), 0)
+            if desc:
+                return (last_attr(ref), desc)
+        return None
+
+    def _fn_hazard(self, mod, fn, facts, visited, depth):
+        if id(fn) in visited or depth > 4:
+            return None
+        visited.add(id(fn))
+        return self._fn_hazard_body(mod, None, fn, facts, visited,
+                                    depth)
+
+    def _fn_hazard_body(self, mod, info, fn, facts, visited, depth):
+        patterns = mod.cfg.lock_name_patterns
+        safe = mod.cfg.signal_safe_calls
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        is_lock = lambda e: _is_lock_pattern_expr(e, patterns)  # noqa: E731
+        holder = ast.FunctionDef(
+            name="_h", args=ast.arguments(
+                posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                defaults=[]),
+            body=body, decorator_list=[]) \
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) else fn
+        for node, _held in lock_scoped_nodes(holder, is_lock):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if is_lock(item.context_expr):
+                        return ("acquires lock "
+                                f"'{is_lock(item.context_expr)}'")
+            if not isinstance(node, ast.Call):
+                continue
+            la = last_attr(call_name(node))
+            method = node.func.attr if isinstance(
+                node.func, ast.Attribute) else None
+            eff = la or method
+            # vetted-path match is on the FULL dotted name: a bare
+            # "dump" pattern must not exempt json.dump/pickle.dump —
+            # exactly the non-atomic I/O this checker exists to flag
+            full = call_name(node) or eff
+            if full and any(fnmatch.fnmatch(full, p) for p in safe):
+                continue  # the vetted dump path
+            if eff == "acquire":
+                return "acquires a lock via .acquire()"
+            if eff in _REGISTRY_ALLOC:
+                return (f"allocates through the metrics registry "
+                        f"('{eff}' takes the registry lock)")
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _HANDLER_IO) \
+                    or (method in _HANDLER_IO):
+                return f"performs non-atomic I/O ('{eff}')"
+            # transitive: a helper that locks/allocates/does I/O
+            frame = info if info is not None else None
+            for g in facts.resolve(mod, node, frame):
+                desc = self._fn_hazard(mod, g, facts, visited,
+                                       depth + 1)
+                if desc:
+                    return f"calls '{g.name}', which {desc}"
+        return None

@@ -314,6 +314,59 @@ class LintConfig:
         "*_train_step", "*_eval_step", "*_step_fn", "train_step",
         "eval_step",
     ])
+    # -- concurrency tier (JX118-JX122, tools/jaxlint/concurrency.py) --
+    # Name patterns (matched case-insensitively against the FINAL
+    # attribute/name segment) treated as mutex objects: `with self._lock:`
+    # scopes, `.acquire()` receivers, and the instance lock JX118 expects
+    # shared state to hide behind.
+    lock_name_patterns: list[str] = field(default_factory=lambda: [
+        "*lock*", "*mutex*", "*_mu",
+    ])
+    # Call-name patterns treated as host-BLOCKING while a lock is held
+    # (JX119): HTTP round-trips, subprocess waits, file I/O, sleeps.
+    # Structural rules ride along in the checker: zero-arg `.get()` /
+    # `.join()` / `.wait()` are unbounded queue/thread/event blocks
+    # (a timeout argument bounds them; `str.join(iterable)` has an
+    # argument and is skipped), and resolved calls to helpers that
+    # TRANSITIVELY block are flagged through the project call graph.
+    lock_blocking_calls: list[str] = field(default_factory=lambda: [
+        "urlopen", "*.urlopen", "requests.get", "requests.post",
+        "requests.put", "requests.request", "subprocess.run",
+        "subprocess.check_output", "subprocess.check_call",
+        "subprocess.call", "*.communicate", "*.getresponse",
+        "*.recv", "*.accept", "*.connect", "open", "*.read_text",
+        "*.write_text", "*.read_bytes", "*.write_bytes", "*.flush",
+        "time.sleep",
+    ])
+    # Cross-host collective/barrier calls (JX120's flock-across-
+    # collective rule): holding ANY lock across one of these deadlocks
+    # the fleet the moment a peer blocked at the barrier needs the same
+    # lock — the PR 8 hazard (the Trainer's cluster save is lock-free
+    # for exactly this reason).
+    collective_calls: list[str] = field(default_factory=lambda: [
+        "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+        "pswapaxes", "wait_at_barrier", "sync_global_devices",
+        "await_all_arrived",
+    ])
+    # Import roots that make fork-based multiprocessing unsafe (JX121):
+    # once jax/tf runtime threads + locks exist, a forked child
+    # inherits locked mutexes with no owner thread and wedges on first
+    # use — the PR 2 tier-1 deadlock. Modules reaching these imports
+    # (directly or through the project import graph) must create
+    # Pool/Process/Queue through an explicit spawn context.
+    fork_unsafe_imports: list[str] = field(default_factory=lambda: [
+        "jax", "tensorflow",
+    ])
+    # Call names (matched against the FULL dotted name — a bare "dump"
+    # would exempt json.dump/pickle.dump, exactly the non-atomic I/O
+    # JX122 flags) VETTED for use inside signal handlers: the
+    # flight-recorder dump path is written to be best-effort/atomic
+    # and never raises (obs/distributed.FlightRecorder.dump /
+    # flight_dump), so handlers may route through it; everything else
+    # that locks/allocates/does I/O in a handler is flagged.
+    signal_safe_calls: list[str] = field(default_factory=lambda: [
+        "flight_dump", "self.dump",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -334,7 +387,9 @@ def load_config(path: str | Path | None) -> LintConfig:
         "key_fresheners", "key_name_patterns", "constraint_funcs",
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
         "timed_funcs", "loop_sleep_funcs", "wire_funcs",
-        "cluster_funcs", "sentinel_funcs", "span_funcs", "disable",
+        "cluster_funcs", "sentinel_funcs", "span_funcs",
+        "lock_name_patterns", "lock_blocking_calls", "collective_calls",
+        "fork_unsafe_imports", "signal_safe_calls", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))

@@ -1053,7 +1053,11 @@ def run_paths(paths: Iterable[str | Path], cfg: LintConfig | None = None,
                     continue
                 result.findings.append(f)
     if use_baseline:
-        result.stale_baseline = [b for b in cfg.baseline if b.hits == 0]
+        # a --select run can't hit baselines for unselected checkers;
+        # only entries whose code actually ran can be called stale
+        ran = {c.code for c in active}
+        result.stale_baseline = [b for b in cfg.baseline
+                                 if b.hits == 0 and b.code in ran]
     result.findings.sort(key=lambda f: (f.path, f.line, f.code))
     return result
 
