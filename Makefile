@@ -67,20 +67,50 @@ lint-threads:
 	    $(LINT_PATHS)
 
 # compiled-IR contract gate, registry-wide (tools/jaxlint/ircheck.py):
-# lowers the REAL train step of every registry model and verifies
-# donation aliasing (JX104 enforcement), dtype discipline (no f64, no
-# f32 pixels on the wire), jaxpr stability across two bucket sizes,
-# collective axis names vs the mesh, and the per-model hbm_gb_per_step
-# regression ledger (±5%, jaxlint.toml [[ircheck.hbm]]). The --fast
-# subset gates every PR inside `make lint`; this full sweep compiles
-# every family (minutes on a CPU box — heavy models live here, not in
-# tier-1) and is the gate when step/model/optimizer code moves.
+# lowers the REAL train step of every registry model (under its
+# config's declared numerics policy) and verifies donation aliasing
+# (JX104 enforcement), dtype discipline (no f64, no f32 pixels on the
+# wire), jaxpr stability across two bucket sizes, collective axis
+# names vs the mesh, the per-model hbm_gb_per_step cost-analysis
+# ledger AND the backend-neutral wire_gb_per_step ledger (±5%,
+# jaxlint.toml [[ircheck.hbm]]), plus the --diet assertion: each
+# case's bf16-policy trace vs its f32 twin must clear the
+# [[ircheck.diet]] reduction floors (ISSUE 15; the cpu backend
+# float-normalizes convs, so cost analysis alone cannot see the
+# dtype diet — measured in tools/jaxlint/ircheck.jaxpr_wire_bytes's
+# docstring). The --fast subset gates every PR inside `make lint`;
+# this full sweep compiles every family (minutes on a CPU box — heavy
+# models live here, not in tier-1) and is the gate when
+# step/model/optimizer/precision code moves.
 lint-ir:
-	$(PY) -m tools.jaxlint.ircheck
+	$(PY) -m tools.jaxlint.ircheck --diet
 
-# the item-2 worklist: per-model f32 activation surface from the jaxpr
+# post-diet residual: the remaining f32 surface per model — by design
+# the policy floors only (BN statistics accumulation, f32 heads and
+# carriers, loss reductions; JX123 keeps new raw-f32 out)
 bf16-ready:
 	$(PY) -m tools.jaxlint.ircheck --bf16-ready
+
+# mixed-precision smoke (ISSUE 15): a short lenet synthetic run must
+# CONVERGE under the scaled-bf16 policy (train_top1 strictly improves
+# over the pre-train eval) with the mp_* metrics present, and the
+# fast-tier ledger (hbm + wire + donation) must hold — the
+# `make check` numerics-policy gate
+precision-smoke:
+	@mkdir -p logs; L="logs/precision-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	rm -rf runs/precision-smoke; \
+	$(PY) train.py -m lenet5 --platform cpu --precision bf16_scaled \
+		--epochs 2 --synthetic-size 512 --batch-size 64 \
+		--workdir runs/precision-smoke 2>&1 | tee "$$L" && \
+	grep -q "train_mp_loss_scale" "$$L" && \
+	grep -q "train_mp_grads_finite=1" "$$L" && \
+	$(PY) -c "import json, re, sys; \
+	    log = open('$$L'.strip()).read(); \
+	    top1 = [float(m) for m in re.findall(r'val_top1=([0-9.e+-]+)', log)]; \
+	    assert len(top1) >= 2 and top1[-1] > top1[0] + 0.2, top1; \
+	    print(f'precision-smoke converged: val_top1 {top1[0]} -> {top1[-1]}')" && \
+	$(PY) -m tools.jaxlint.ircheck --fast 2>&1 | tee -a "$$L" && \
+	echo "precision-smoke OK (bf16_scaled converged + fast ledger green)"
 
 # serving smoke: boot the stdin-JSONL server on lenet5 (compiles its
 # bucket executables at startup), push 3 requests through the engine,
@@ -252,7 +282,7 @@ threadcheck-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke
+check: lint serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -376,4 +406,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-threads lint-ir bf16-ready check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-threads lint-ir bf16-ready precision-smoke check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal

@@ -327,9 +327,14 @@ def _zoo_case(name):
     if name == "hourglass104":
         import jax.numpy as jnp
 
-        # f32: the r4 bf16-cripples-hourglass finding pins the config
+        from deepvision_tpu.core.precision import get_policy
+
+        # the shipped policy since ISSUE 15: bf16_scaled (f32 residual
+        # carrier + MixedBatchNorm + loss scaling) with stack remat —
+        # the r4 f32 pin is superseded by the structural fix
+        policy = get_policy("bf16_scaled")
         model = get_model("hourglass104", num_heatmaps=16,
-                          dtype=jnp.float32)
+                          dtype=policy.compute_dtype, remat="stack")
         bs = 8
         batch = {
             "image": rng.normal(size=(bs, 256, 256, 3)).astype(np.float32),
@@ -338,7 +343,8 @@ def _zoo_case(name):
             "v": np.ones((bs, 16), np.float32),
         }
         tx = optax.rmsprop(2.5e-4)
-        state = create_train_state(model, tx, batch["image"][:1])
+        state = create_train_state(model, tx, batch["image"][:1],
+                                   policy=policy)
         return state, batch, S.pose_train_step
     if name == "dcgan":
         # the zoo's one non-classification-step family: the full
@@ -767,6 +773,132 @@ def _pipeline_benches(state, step, mesh, key, batch_size, n_chips) -> dict:
 # stays seconds-cheap even on a CPU-only container.
 SERVE_REQUESTS = 512
 SERVE_SEQ_CALLS = 64
+
+
+PRECISION_MODEL = os.environ.get("BENCH_PRECISION_MODEL", "resnet50")
+PRECISION_BATCH = int(os.environ.get("BENCH_PRECISION_BATCH", "0")) \
+    or None  # None = BATCH_PER_CHIP * n_chips
+PRECISION_WARMUP = 2
+PRECISION_STEPS = int(os.environ.get("BENCH_PRECISION_STEPS", "8"))
+PRECISION_REPS = int(os.environ.get("BENCH_PRECISION_REPS", "3"))
+
+
+def precision_bench() -> dict:
+    """``bench.py precision`` — the ISSUE 15 diet as ONE JSON row:
+    the flagship model's shipped mixed-precision policy vs its f32
+    twin, INTERLEAVED rep-by-rep (thermal/noise decorrelation),
+    reporting img/s/chip, cost-analysis ``hbm_gb_per_step``, the
+    backend-neutral ``wire_gb_per_step`` (tools/jaxlint/ircheck.
+    jaxpr_wire_bytes — the dtype-faithful number on backends whose
+    float normalization hides bf16 from cost analysis, like this dev
+    box's cpu), and MFU side by side. ``BENCH_PRECISION_MODEL`` /
+    ``_BATCH`` / ``_STEPS`` / ``_REPS`` override the defaults; the
+    driver's on-chip r05 run records the real-silicon row."""
+    from functools import partial
+
+    from deepvision_tpu.core import create_mesh, shard_batch
+    from deepvision_tpu.core.precision import get_policy
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.configs import get_config
+    from deepvision_tpu.train.optimizers import make_optimizer
+    from deepvision_tpu.train.state import create_train_state
+    from deepvision_tpu.train.steps import classification_train_step
+    from tools.hbm_budget import hbm_gb_per_step
+    from tools.jaxlint.ircheck import jaxpr_wire_bytes
+
+    n_chips = len(jax.devices())
+    mesh = create_mesh(n_chips, 1)
+    cfg = get_config(PRECISION_MODEL)
+    batch_size = PRECISION_BATCH or BATCH_PER_CHIP * n_chips
+    size, ch = cfg["input_size"], cfg["channels"]
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 100e12)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": rng.integers(0, 255, (batch_size, size, size, ch)
+                              ).astype(np.uint8),
+        "label": rng.integers(0, cfg["num_classes"],
+                              size=(batch_size,)).astype(np.int32),
+    }
+    norm = "torch" if cfg.get("augment") == "pt" else "imagenet"
+    step_fn = partial(classification_train_step, normalize_kind=norm)
+    device_batch = shard_batch(mesh, batch)
+
+    arms = {}
+    for arm_name in (cfg["precision"], "f32"):
+        policy = get_policy(arm_name)
+        model = get_model(PRECISION_MODEL,
+                          num_classes=cfg["num_classes"],
+                          dtype=policy.compute_dtype,
+                          **cfg.get("model_kwargs", {}))
+        tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+        state = create_train_state(model, tx, batch["image"][:1],
+                                   policy=policy)
+        step = compile_train_step(step_fn, mesh)
+        key = jax.random.key(0)
+        wire_gb = jaxpr_wire_bytes(
+            jax.make_jaxpr(step_fn)(
+                jax.eval_shape(lambda: state), device_batch, key
+            ).jaxpr) / 1e9
+        compiled = step.lower(state, device_batch, key).compile()
+        arms[arm_name] = {
+            "state": state, "compiled": compiled, "key": key,
+            "hbm_gb_per_step": round(hbm_gb_per_step(compiled), 3),
+            "wire_gb_per_step": round(wire_gb, 3),
+            "flops_per_step": _flops_per_step(compiled),
+            "times": [],
+        }
+        for _ in range(PRECISION_WARMUP):
+            k, sub = jax.random.split(arms[arm_name]["key"])
+            arms[arm_name]["key"] = k
+            arms[arm_name]["state"], _m = compiled(
+                arms[arm_name]["state"], device_batch, sub)
+        _sync_scalar(arms[arm_name]["state"])
+
+    for _rep in range(PRECISION_REPS):  # interleaved A/B chunks
+        for arm in arms.values():
+            t0 = time.perf_counter()
+            for _ in range(PRECISION_STEPS):
+                k, sub = jax.random.split(arm["key"])
+                arm["key"] = k
+                arm["state"], _m = arm["compiled"](
+                    arm["state"], device_batch, sub)
+            _sync_scalar(arm["state"])
+            arm["times"].append(time.perf_counter() - t0)
+
+    out = {"metric": f"precision_ab_{PRECISION_MODEL}",
+           "batch": batch_size, "device_kind": kind,
+           "steps_per_rep": PRECISION_STEPS, "reps": PRECISION_REPS}
+    for arm_name, arm in arms.items():
+        dt = float(np.median(arm["times"]))
+        rate = PRECISION_STEPS * batch_size / dt / n_chips
+        mfu = (arm["flops_per_step"] * PRECISION_STEPS / dt / peak
+               if arm["flops_per_step"] else None)
+        out[arm_name] = {
+            "img_per_sec_per_chip": round(rate, 1),
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "hbm_gb_per_step": arm["hbm_gb_per_step"],
+            "wire_gb_per_step": arm["wire_gb_per_step"],
+        }
+    policy_name, f32 = cfg["precision"], "f32"
+    if policy_name != f32:
+        a, b = out[policy_name], out[f32]
+        out["throughput_ratio"] = round(
+            a["img_per_sec_per_chip"] / b["img_per_sec_per_chip"], 3)
+        out["wire_reduction"] = round(
+            1 - a["wire_gb_per_step"] / b["wire_gb_per_step"], 4)
+        out["hbm_reduction"] = round(
+            1 - a["hbm_gb_per_step"] / b["hbm_gb_per_step"], 4)
+    return out
+
+
+def _sync_scalar(state) -> None:
+    """Drain the dispatch queue through the full dependency chain (the
+    same full-chain sync the headline bench uses — block_until_ready on
+    one output does not reliably drain through the device relay)."""
+    leaf = jax.tree_util.tree_leaves(state.params)[-1]
+    float(np.asarray(leaf).reshape(-1)[0])
 
 
 def cluster_bench() -> dict:
@@ -1594,6 +1726,8 @@ if __name__ == "__main__":
     try:
         if "cluster" in sys.argv[1:]:
             print(json.dumps(cluster_bench()))
+        elif "precision" in sys.argv[1:]:
+            print(json.dumps(precision_bench()))
         elif "sentinel" in sys.argv[1:]:
             print(json.dumps(sentinel_bench()))
         elif "serve" in sys.argv[1:]:

@@ -56,8 +56,12 @@ class ResidualBlock(nn.Module):
         f, d = self.features, self.dtype
 
         def bn(x, name):
-            return nn.BatchNorm(use_running_average=not train,
-                                dtype=jnp.float32, name=name)(x)
+            # MixedBatchNorm: f32 statistics, compute-dtype apply —
+            # the ISSUE 15 recipe (f32 stats pins removed zoo-wide)
+            from deepvision_tpu.models.layers import MixedBatchNorm
+
+            return MixedBatchNorm(use_running_average=not train,
+                                  dtype=d, name=name)(x)
 
         identity = x
         if x.shape[-1] != f or self.strides > 1:
@@ -71,7 +75,10 @@ class ResidualBlock(nn.Module):
         y = nn.Conv(f, (3, 3), use_bias=False, kernel_init=he_normal,
                     dtype=d, name="conv2")(y)
         y = bn(y, "bn2")
-        return nn.relu(identity + y)
+        # f32 residual CARRIER through the 2-stack order-5 recursion —
+        # same structural guard as models/hourglass.py (no-op at f32)
+        hd = jnp.promote_types(d, jnp.float32)
+        return nn.relu(identity.astype(hd) + y.astype(hd))
 
 
 class LargeHourglass(nn.Module):
@@ -146,8 +153,10 @@ class CenterNet(nn.Module):
         d = self.dtype
 
         def bn(x, name):
-            return nn.BatchNorm(use_running_average=not train,
-                                dtype=jnp.float32, name=name)(x)
+            from deepvision_tpu.models.layers import MixedBatchNorm
+
+            return MixedBatchNorm(use_running_average=not train,
+                                  dtype=d, name=name)(x)
 
         # Stem (ref: model.py:140-145): 7x7/2 128 → residual 256 /2.
         x = nn.Conv(128, (7, 7), strides=(2, 2), use_bias=False,
@@ -176,7 +185,9 @@ class CenterNet(nn.Module):
                 x2 = nn.Conv(256, (1, 1), use_bias=True, dtype=d,
                              name=f"remap_prev{s}")(inter)
                 x2 = bn(x2, f"remap_prev{s}_bn")
-                inter = nn.relu(x1 + x2)
+                # cross-stack carrier stays f32 (no-op at f32)
+                hd = jnp.promote_types(d, jnp.float32)
+                inter = nn.relu(x1.astype(hd) + x2.astype(hd))
                 # re-injection passes THROUGH the residual (ref defect :176)
                 inter = ResidualBlock(256, dtype=d,
                                       name=f"remap_res{s}")(inter, train)

@@ -60,11 +60,16 @@ class PreActBottleneck(nn.Module):
                                name="proj")(x)
 
         def bn(x, name):
-            # f32 is a precision FLOOR (the r4 bf16-cripples-hourglass
-            # finding), not a ceiling: f64 runs keep f64
-            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                dtype=jnp.promote_types(d, jnp.float32),
-                                name=name)(x)
+            # MixedBatchNorm: statistics always f32; the elementwise
+            # apply runs in the block's compute dtype. The r4
+            # bf16-cripples-hourglass finding is addressed structurally
+            # instead of by a dtype pin: the cross-stack/residual
+            # CARRIER stays f32 (StackedHourglass), so bf16 rounding no
+            # longer compounds through the recursive depth.
+            from deepvision_tpu.models.layers import MixedBatchNorm
+
+            return MixedBatchNorm(use_running_average=not train,
+                                  momentum=0.9, dtype=d, name=name)(x)
 
         y = nn.relu(bn(x, "bn1"))
         y = nn.Conv(f // 2, (1, 1), use_bias=True, kernel_init=he_normal,
@@ -75,7 +80,13 @@ class PreActBottleneck(nn.Module):
         y = nn.relu(bn(y, "bn3"))
         y = nn.Conv(f, (1, 1), use_bias=True, kernel_init=he_normal,
                     dtype=d, name="conv3")(y)
-        return identity + y
+        # f32 residual CARRIER (precision floor, not ceiling): block
+        # internals compute in ``dtype``, but the skip sum accumulates
+        # in promoted f32 so rounding cannot compound through the
+        # order-4 recursion x 4 stacks — the structural fix for the r4
+        # bf16-cripples-hourglass finding. Identity at f32 dtype.
+        hd = jnp.promote_types(d, jnp.float32)
+        return identity.astype(hd) + y.astype(hd)
 
 
 def _upsample2x(x):
@@ -130,17 +141,41 @@ class StackedHourglass(nn.Module):
     num_residual: int = 1
     num_heatmaps: int = 16
     features: int = 256
+    # activation rematerialization (HBM-traffic/memory lever; the
+    # parameter pytree is unchanged — nn.remat is a lifted transform
+    # preserving module names):
+    #   None    — save what XLA saves (default)
+    #   "stack" — save only stack boundaries; each of the 4 hourglass
+    #             modules recomputes its order-4 recursion during
+    #             backward (the deepest activation surface in the zoo)
+    remat: str | None = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         f, d = self.features, self.dtype
+        if self.remat not in (None, "stack"):
+            raise ValueError(
+                f"unknown hourglass remat policy {self.remat!r} "
+                "(None or 'stack')")
+        hg_cls = HourglassModule
+        if self.remat == "stack":
+            # prevent_cse=True (the jax.checkpoint default): stacks are
+            # unrolled, not scanned — without the optimization barriers
+            # XLA's CSE undoes the recompute (same finding as
+            # models/resnet.ResNet.remat)
+            hg_cls = nn.remat(HourglassModule, prevent_cse=True,
+                              static_argnums=(2,))
 
         hd = jnp.promote_types(d, jnp.float32)  # f32 floor, not ceiling
 
         def bn(x, name):
-            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                                dtype=hd, name=name)(x)
+            from deepvision_tpu.models.layers import MixedBatchNorm
+
+            # stem/linear BNs: f32 statistics, compute-dtype apply —
+            # same mixed recipe as the block BNs (layers.MixedBatchNorm)
+            return MixedBatchNorm(use_running_average=not train,
+                                  momentum=0.9, dtype=d, name=name)(x)
 
         # Stem: 7x7/2 → bottleneck(128, proj) → pool → ×2 bottleneck → 256.
         # (ref: hourglass104.py:121-133; 256² → 64²)
@@ -156,8 +191,8 @@ class StackedHourglass(nn.Module):
 
         outputs = []
         for s in range(self.num_stacks):
-            y = HourglassModule(4, f, self.num_residual, dtype=d,
-                                name=f"hg{s}")(x, train)
+            y = hg_cls(4, f, self.num_residual, dtype=d,
+                       name=f"hg{s}")(x, train)
             for i in range(self.num_residual):
                 y = PreActBottleneck(f, dtype=d,
                                      name=f"post{s}_{i}")(y, train)
@@ -181,10 +216,12 @@ class StackedHourglass(nn.Module):
         return tuple(outputs)
 
 
-@register("hourglass104")
+@register("hourglass104", remat="stack")
 def hourglass104(num_heatmaps: int = 16, dtype: Dtype = jnp.float32,
                  **kw) -> StackedHourglass:
     """The MPII configuration: 4 stacks, 1 residual, 16 joints
-    (ref: Hourglass/tensorflow/train.py:211)."""
+    (ref: Hourglass/tensorflow/train.py:211). Per-stack remat is the
+    registry-declared policy (ISSUE 15): the order-4 recursion x 4
+    stacks is the deepest activation surface in the zoo."""
     return StackedHourglass(num_stacks=4, num_residual=1,
                             num_heatmaps=num_heatmaps, dtype=dtype, **kw)

@@ -14,12 +14,149 @@ from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 Dtype = Any
 
 he_normal = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
 xavier_uniform = nn.initializers.xavier_uniform()
+
+
+class MixedBatchNorm(nn.BatchNorm):
+    """BatchNorm with f32 statistics but COMPUTE-dtype normalize math —
+    the mixed-precision BN the HBM diet standardizes on.
+
+    Stock linen (``force_float32_reductions``, the right default for the
+    statistics) also computes the elementwise normalize in f32: with
+    ``dtype=bf16`` the ``x - mean`` promotes the whole activation to f32
+    and every BN materializes full-size f32 intermediates — exactly the
+    f32 surface ``make bf16-ready`` showed dominating the deep models'
+    jaxprs (6 GB on ResNet-152 b4). Here the running statistics, their
+    momentum updates and the per-channel affine stay f32, but the
+    full-size elementwise apply is ONE compute-dtype multiply-add
+    (``x * mul + shift`` with the f32 channel affine folded and cast
+    once — the standard fused-BN-apply form, better bf16 rounding than
+    the unfused ``(x - mean) * mul + bias`` chain). At f32 compute dtype
+    the stock expression tree is used bit-for-bit, so converter-parity
+    configs are unaffected. Parameter/variable names and dtypes are
+    identical to ``nn.BatchNorm`` (checkpoints, the torch converter and
+    the batch_stats pytree see no difference).
+    """
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool | None = None, *,
+                 mask=None):
+        from flax.linen import normalization as N
+        from flax.linen.module import merge_param
+
+        use_running_average = merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        feature_axes = N._canonicalize_axes(x.ndim, self.axis)
+        reduction_axes = tuple(i for i in range(x.ndim)
+                               if i not in feature_axes)
+        feature_shape = [x.shape[ax] for ax in feature_axes]
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32),
+                                feature_shape)
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32),
+                               feature_shape)
+        import numpy as _np
+
+        # STATIC config predicate (module fields only — never data):
+        # picks the trace, does not branch on traced values
+        mixed = (self.dtype is not None
+                 and _np.dtype(self.dtype) != _np.dtype("float32")
+                 and self.axis_name is None)
+        if mask is not None:
+            mixed = False  # masked stats: defer to stock _compute_stats
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        elif mixed:
+            # mixed statistics: moments taken on the COMPUTE-dtype
+            # tensors with f32 accumulators (jnp.mean dtype=f32 —
+            # convert fuses into the reduce), so no full-size f32
+            # copy/square ever materializes. Stock linen upcasts x to
+            # f32 first: that f32 activation copy + its f32 square per
+            # BN are exactly the surviving f32 surface `make
+            # bf16-ready` measured dominating the deep models' jaxprs.
+            # The bf16-rounded moments perturb var by ~2^-8 relative —
+            # noise well under batch-statistics noise; the accumulators
+            # and the channel math stay f32 (no cancellation change vs
+            # stock's use_fast_variance, which also does E[x²]-E[x]²).
+            xc = x.astype(self.dtype)
+            mean = jnp.mean(xc, reduction_axes, dtype=jnp.float32)
+            if self.use_fast_variance:
+                mean2 = jnp.mean(lax.square(xc), reduction_axes,
+                                 dtype=jnp.float32)
+                var = jnp.maximum(mean2 - lax.square(mean), 0.0)
+            else:
+                # two-pass (use_fast_variance=False is chosen exactly
+                # for large-mean activations where E[x²]-E[x]² cancels)
+                d = xc - jnp.expand_dims(mean, reduction_axes).astype(
+                    xc.dtype)
+                var = jnp.mean(lax.square(d), reduction_axes,
+                               dtype=jnp.float32)
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value
+                                 + (1 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value
+                                + (1 - self.momentum) * var)
+        else:
+            mean, var = N._compute_stats(
+                x, reduction_axes, dtype=self.dtype,
+                axis_name=(self.axis_name
+                           if not self.is_initializing() else None),
+                axis_index_groups=self.axis_index_groups,
+                use_fast_variance=self.use_fast_variance, mask=mask,
+                force_float32_reductions=True,
+            )
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value
+                                 + (1 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value
+                                + (1 - self.momentum) * var)
+
+        # per-channel affine in f32 (same param creation order as stock
+        # _normalize: scale then bias — identical pytree)
+        bshape = [1] * x.ndim
+        for ax in feature_axes:
+            bshape[ax] = x.shape[ax]
+        mean = jnp.expand_dims(mean, reduction_axes).astype(jnp.float32)
+        var = jnp.expand_dims(var, reduction_axes).astype(jnp.float32)
+        mul = lax.rsqrt(var + self.epsilon)
+        scale = bias = None
+        if self.use_scale:
+            scale = self.param("scale", self.scale_init, feature_shape,
+                               self.param_dtype).reshape(bshape)
+            mul = mul * scale
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, feature_shape,
+                              self.param_dtype).reshape(bshape)
+
+        # same result-dtype rule as stock _normalize: an explicit
+        # ``dtype`` wins; otherwise promote input and param dtypes
+        from flax.linen import dtypes as flax_dtypes
+
+        args = [x] + [a for a in (scale, bias) if a is not None]
+        out_dtype = flax_dtypes.canonicalize_dtype(*args,
+                                                   dtype=self.dtype)
+        if out_dtype == jnp.float32:
+            # stock expression tree, bit-for-bit (converter parity)
+            y = (x.astype(jnp.float32) - mean) * mul
+            if bias is not None:
+                y = y + bias
+            return jnp.asarray(y, out_dtype)
+        # mixed apply: fold the channel affine in f32, cast ONCE, run
+        # the full-size elementwise in the compute dtype
+        shift = -mean * mul
+        if bias is not None:
+            shift = shift + bias
+        return (x.astype(out_dtype) * mul.astype(out_dtype)
+                + shift.astype(out_dtype))
 
 
 class ConvBN(nn.Module):
@@ -66,7 +203,7 @@ class ConvBN(nn.Module):
         # re-reading separately saved post-BN activations from HBM.
         # A plain no-op identity outside any remat scope.
         x = checkpoint_name(x, "conv_out")
-        x = nn.BatchNorm(
+        x = MixedBatchNorm(
             use_running_average=not train,
             momentum=self.bn_momentum,
             epsilon=self.bn_epsilon,

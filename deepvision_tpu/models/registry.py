@@ -1,22 +1,42 @@
-"""Model registry: name -> Flax module factory.
+"""Model registry: name -> Flax module factory (+ per-model policies).
 
 Replaces the reference's per-trainer ``training_config`` model lookup
 (ref: ResNet/pytorch/train.py:541-562 argparse choices) with one global
 registry shared by the CLI, tests, converter, and benchmarks.
+
+Since the HBM diet (ISSUE 15) a registration also DECLARES the model's
+rematerialization policy — the activation-recompute schedule the deep
+models trade FLOPs for HBM with (``jax.checkpoint`` through the module's
+own ``remat`` field; ResNet ``"block"``/``"conv"``, Hourglass
+``"stack"``). The registry only declares it: the TRAINING builders
+(``train/configs.get_config`` → ``model_kwargs``) apply it, because
+remat's ``prevent_cse`` optimization barriers belong in the train step's
+backward, not in forward-only serving programs.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Callable
 
-_REGISTRY: dict[str, Callable] = {}
+@dataclass(frozen=True)
+class _Entry:
+    factory: Callable
+    remat: str | None = None
 
 
-def register(name: str):
+_REGISTRY: dict[str, _Entry] = {}
+
+
+def register(name: str, *, remat: str | None = None):
+    """Register a model factory; ``remat`` declares the model's default
+    rematerialization policy (a value the factory's module must accept
+    as its ``remat`` field)."""
+
     def deco(factory):
         if name in _REGISTRY:
             raise ValueError(f"duplicate model name {name!r}")
-        _REGISTRY[name] = factory
+        _REGISTRY[name] = _Entry(factory, remat)
         return factory
 
     return deco
@@ -24,12 +44,23 @@ def register(name: str):
 
 def get_model(name: str, **kwargs):
     try:
-        factory = _REGISTRY[name]
+        entry = _REGISTRY[name]
     except KeyError:
         raise KeyError(
             f"unknown model {name!r}; known: {sorted(_REGISTRY)}"
         ) from None
+    # bare callables tolerated: tests (and downstream monkeypatchers)
+    # insert plain factories into _REGISTRY without the _Entry wrapper
+    factory = entry.factory if isinstance(entry, _Entry) else entry
     return factory(**kwargs)
+
+
+def model_remat(name: str) -> str | None:
+    """The registry-declared remat policy for ``name`` (None when the
+    model has none — or is unknown, so config plumbing can ask about
+    CLI-only config aliases like the GAN trainers)."""
+    entry = _REGISTRY.get(name)
+    return entry.remat if isinstance(entry, _Entry) else None
 
 
 def list_models() -> list[str]:

@@ -309,8 +309,11 @@ def _resnet50(**kw):
     return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock, **kw)
 
 
-@register("resnet152")
+@register("resnet152", remat="block")
 def _resnet152(**kw):
+    # block-boundary remat declared as the registry default (ISSUE 15):
+    # at 36 stage-3 blocks the saved-activation surface dominates the
+    # step's HBM; recompute inside each block trades MXU headroom for it
     return ResNet(stage_sizes=(3, 8, 36, 3), block=BottleneckBlock, **kw)
 
 

@@ -466,12 +466,24 @@ class SentinelMonitor:
         reg = registry if registry is not None else default_registry()
         self.trips = reg.counter("sentinel_trips")
         self.audits = reg.counter("sentinel_audits")
+        self.scale_backoffs = reg.counter("sentinel_scale_backoffs")
 
     def observe(self, epoch: int, step_in_epoch: int,
                 metrics: dict) -> None:
         """Fold one drained step's metrics in; raises
         :class:`SentinelTrip` on the first watched series that
-        z-scores out of band."""
+        z-scores out of band.
+
+        Mixed-precision composition (core/precision.py): a step whose
+        ``mp_grads_finite`` is 0 was a dynamic-loss-scale BACKOFF — the
+        overflow was caught in-graph, the update skipped and the scale
+        halved, so the step's metrics are deliberately untrustworthy
+        and the detector must neither trip on them nor fold them into
+        its history. Counted separately (``sentinel_scale_backoffs``);
+        a trip stays what it always was: an anomaly nothing handled."""
+        if metrics.get("mp_grads_finite", 1.0) < 0.5:
+            self.scale_backoffs.inc()
+            return
         for key in self.WATCH_KEYS:
             if key not in metrics:
                 continue

@@ -301,6 +301,11 @@ class CheckpointManager:
         }
         if getattr(state, "extra_vars", None) is not None:
             payload["extra_vars"] = state.extra_vars
+        if getattr(state, "loss_scale", None) is not None:
+            # mixed-precision scale state (core/precision.py): the
+            # grow/backoff schedule must survive a resume — a reset
+            # scale re-runs the whole warmup and can re-skip steps
+            payload["loss_scale"] = state.loss_scale
         return payload
 
     def latest_epoch(self) -> int | None:
@@ -391,13 +396,34 @@ class CheckpointManager:
         """-> (state, meta dict with 'epoch', 'loggers', 'extra')."""
         epoch = self._resolve_epoch(epoch)
         template = self._payload(state)
-        restored = self._mgr.restore(
-            epoch,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(template),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+        try:
+            restored = self._mgr.restore(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(template),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
+        except Exception:
+            if "loss_scale" not in template:
+                raise
+            # migration: a pre-mixed-precision checkpoint (saved before
+            # the config declared a scaling policy) has no loss_scale
+            # item — restore everything else and keep the FRESH scale
+            # state (it re-warms from init_scale; the alternative is a
+            # hard crash until the operator guesses --precision f32)
+            template = {k: v for k, v in template.items()
+                        if k != "loss_scale"}
+            restored = self._mgr.restore(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(template),
+                    meta=ocp.args.JsonRestore(),
+                ),
+            )
+            print("[ckpt] pre-mixed-precision checkpoint (no saved "
+                  "loss_scale): restored state, keeping a fresh "
+                  "loss-scale state", flush=True)
         state = state.replace(**restored["state"])
         return state, self._decode_meta(restored["meta"])
 

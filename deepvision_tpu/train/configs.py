@@ -13,6 +13,7 @@ from __future__ import annotations
 TRAINING_CONFIG: dict[str, dict] = {
     # ref: LeNet/pytorch/train.py:18-30 — batch 64, Adam 1e-3, plateau, 50ep
     "lenet5": {
+        "precision": "f32",
         "batch_size": 64,
         "input_size": 32,
         "channels": 1,
@@ -26,6 +27,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: ResNet/pytorch/train.py:27-51 (SGD 0.01/0.9/5e-4, plateau max)
     "alexnet1": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
@@ -38,6 +40,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:52-73
     "alexnet2": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
@@ -50,6 +53,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:74-100 (StepLR 10/0.5)
     "vgg16": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
@@ -62,6 +66,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:101-117
     "vgg19": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 64,
         "input_size": 224,
@@ -74,6 +79,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:118-136 (poly decay lambda)
     "inception1": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
@@ -85,6 +91,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:137-163 (SGD 0.1/0.9/1e-4, plateau max, batch 256)
     "resnet34": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
@@ -101,6 +108,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:164-180 — the north-star accuracy config (73.93% top-1)
     "resnet50": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
@@ -116,6 +124,11 @@ TRAINING_CONFIG: dict[str, dict] = {
         "model_kwargs": {"s2d_stem": True},
     },
     "resnet152": {
+        "precision": "bf16",
+        # block-boundary remat (models/resnet.ResNet.remat, registry
+        # default): trade recompute for the 36-deep stage-3 activation
+        # surface — the ISSUE 15 HBM diet for the deepest classifier
+        "remat": "block",
         "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
@@ -131,6 +144,7 @@ TRAINING_CONFIG: dict[str, dict] = {
         "model_kwargs": {"s2d_stem": True},
     },
     "resnet50v2": {
+        "precision": "bf16",
         "batch_size": 256,
         "input_size": 224,
         "optimizer": "sgd",
@@ -142,6 +156,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # ref: train.py:181-214 (RMSprop 0.045/alpha .9/eps 1.0, StepLR 2/0.94)
     "mobilenet1": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 128,
         "input_size": 224,
@@ -153,6 +168,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # reference WIP — config completed per the ShuffleNet paper (linear decay)
     "shufflenet1": {
+        "precision": "bf16",
         "augment": "pt",
         "batch_size": 256,
         "input_size": 224,
@@ -165,6 +181,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     },
     # reference stub — config per Inception V3 paper
     "inception3": {
+        "precision": "bf16",
         "batch_size": 128,
         "input_size": 299,
         "optimizer": "rmsprop",
@@ -176,6 +193,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     # Darknet-53 ImageNet pretraining for the YOLO backbone (paper config;
     # the reference trains detection from scratch and has no pretrain path)
     "darknet53": {
+        "precision": "bf16",
         "batch_size": 128,
         "input_size": 256,
         "optimizer": "sgd",
@@ -188,6 +206,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     # ref: YOLO/tensorflow/train.py:13-29 — per-replica batch 16, Adam 0.01,
     # /10 plateau on val loss (simulated ReduceLROnPlateau :56-68), 300 ep
     "yolov3": {
+        "precision": "bf16",
         "batch_size": 16,
         "input_size": 416,
         "num_classes": 20,  # VOC; 80 for COCO (ref: train.py:14)
@@ -201,6 +220,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     # ref: DCGAN/tensorflow/main.py:13-17,31-32 — batch 256, two Adams
     # 1e-4, 50 epochs, noise dim 100, checkpoint every 2 epochs keep 3
     "dcgan": {
+        "precision": "bf16",
         "batch_size": 256,
         "input_size": 28,
         "channels": 1,
@@ -215,6 +235,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     # default), two Adams 2e-4 β1 0.5, LinearDecay to 0 over epochs
     # 100..200, pool 50, λ_cycle 10, λ_id 5
     "cyclegan": {
+        "precision": "bf16",
         "batch_size": 4,
         "input_size": 256,
         "dataset": "gan_unpaired",
@@ -230,6 +251,7 @@ TRAINING_CONFIG: dict[str, dict] = {
     # we deliberately use 1e-3: 0.01 destabilizes penalty-reduced focal
     # loss (the paper itself trains hourglass CenterNet at 2.5e-4).
     "centernet": {
+        "precision": "bf16",
         "batch_size": 16,
         "input_size": 256,
         "num_classes": 80,  # MSCOCO (ref model.py:131)
@@ -251,11 +273,19 @@ TRAINING_CONFIG: dict[str, dict] = {
         "dataset": "pose",
         "optimizer": "adam",
         "optimizer_params": {"lr": 1e-4},
-        # bf16 cripples this net: the heatmap regression has unbounded
-        # f32-scale outputs and the deep recursive hourglass compounds
-        # bf16 rounding — measured r4: 30 epochs of the synthetic gate
-        # reached loss 74 in bf16 vs 5.1 in f32 (logs/gate_pose_r4*.log)
-        "precision": "f32",
+        # r4 measured plain bf16 crippling this net (synthetic gate
+        # loss 74 vs 5.1 at 30 epochs — bf16 rounding compounding
+        # through the recursion). ISSUE 15 addressed the mechanism
+        # structurally: the residual/cross-stack carrier now accumulates
+        # in f32 (models/hourglass.py) with only block internals in
+        # bf16, plus dynamic loss scaling as the wide-range heatmap
+        # regression's guard — the bf16-vs-f32 twin gate
+        # (tests/test_precision.py) pins the trajectory agreement.
+        "precision": "bf16_scaled",
+        # per-stack remat (models/hourglass.StackedHourglass.remat,
+        # registry default): the order-4 recursion x 4 stacks is the
+        # deepest activation surface in the zoo
+        "remat": "stack",
         # mode "max" on the Trainer's negated val loss (the yolov3
         # convention): lower loss -> higher metric -> improvement
         "scheduler": "plateau",
@@ -282,5 +312,24 @@ def get_config(name: str) -> dict:
     cfg.setdefault("channels", 3)
     cfg.setdefault("num_classes", 1000)
     cfg.setdefault("dataset", "imagenet")
+    # numerics policy (ISSUE 15): every shipped entry declares
+    # "precision" explicitly (the table is the single source of truth —
+    # CLI --precision overrides, nothing else does); the setdefault
+    # only covers ad-hoc test configs built outside the table
+    cfg.setdefault("precision", "bf16")
+    # remat: config declaration wins; else the registry-declared
+    # per-model policy (models/registry.model_remat). Folded into
+    # model_kwargs so every builder that constructs the model from this
+    # config (train.py, evalcheck, ircheck, bench) compiles the policy.
+    if "remat" not in cfg:
+        # the package import (not bare registry) guarantees the
+        # registration side effects ran before the lookup
+        import deepvision_tpu.models  # noqa: F401
+        from deepvision_tpu.models.registry import model_remat
+
+        cfg["remat"] = model_remat(base)
+    if cfg["remat"] is not None:
+        mk = cfg.setdefault("model_kwargs", {})
+        mk.setdefault("remat", cfg["remat"])
     cfg["name"] = name
     return cfg

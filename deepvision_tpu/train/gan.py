@@ -41,7 +41,14 @@ class GANState:
     """Two-network train state. ``params``/``batch_stats`` are dicts keyed
     by network role; ``opt_state`` holds one optax state per optimizer
     ('generator' spans all generator nets, 'discriminator' all critics —
-    the reference's optimizer pairing, ref: CycleGAN/train.py:126-127)."""
+    the reference's optimizer pairing, ref: CycleGAN/train.py:126-127).
+
+    ``loss_scale`` (core/precision.py): ONE shared DynamicLossScale
+    over both phases when the precision policy scales — a non-finite
+    grad in EITHER tape skips both updates for the step and backs the
+    scale off (the two-network coupling means half an update is worse
+    than none). None = empty pytree, f32-era states flatten identically.
+    """
 
     step: jax.Array
     params: Any
@@ -53,6 +60,13 @@ class GANState:
     g_tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
     d_tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
     noise_dim: int = flax.struct.field(pytree_node=False, default=100)
+    loss_scale: Any = None
+
+    def scale_loss(self, loss):
+        """Loss scaled for a backward (identity without a scaler)."""
+        if self.loss_scale is None:
+            return loss
+        return self.loss_scale.scale_loss(loss)
 
 
 def _bce(logits, is_real: bool, smooth: float = 0.0):
@@ -73,6 +87,57 @@ def _l1(a, b):
     return jnp.mean(jnp.abs(a - b))
 
 
+def _gan_apply_gradients(state: "GANState", g_grads, d_grads, *,
+                         g_params, d_params, batch_stats, assemble,
+                         extra_vars=None):
+    """Shared two-optimizer update for both GAN steps: with a
+    DynamicLossScale on the state, unscale both tapes' grads, gate the
+    WHOLE step (params, opt states, BN stats, pools) on their joint
+    finiteness, and grow/backoff the scale; plain updates otherwise.
+    ``assemble(new_gp, new_dp)`` rebuilds the full params dict from the
+    updated subsets. Returns ``(new_state, mp_metrics)``."""
+    from deepvision_tpu.core.precision import (
+        all_finite,
+        precision_metrics,
+        tree_select,
+    )
+
+    ls = state.loss_scale
+    new_ls, finite = None, None
+    if ls is not None:
+        g_grads, d_grads = ls.unscale(g_grads), ls.unscale(d_grads)
+        finite = all_finite({"g": g_grads, "d": d_grads})
+        new_ls = ls.adjust(finite)
+        # zero non-finite grads BEFORE the optimizer so inf*0 NaNs
+        # cannot poison the moment estimates ahead of the select
+        zero = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda g: jnp.where(finite, g, jnp.zeros_like(g)), t)
+        g_grads, d_grads = zero(g_grads), zero(d_grads)
+    g_up, g_opt = state.g_tx.update(
+        g_grads, state.opt_state["generator"], g_params)
+    d_up, d_opt = state.d_tx.update(
+        d_grads, state.opt_state["discriminator"], d_params)
+    new_params = assemble(optax.apply_updates(g_params, g_up),
+                          optax.apply_updates(d_params, d_up))
+    new_opt = {"generator": g_opt, "discriminator": d_opt}
+    new_ev = state.extra_vars if extra_vars is None else extra_vars
+    if ls is not None:
+        new_params = tree_select(finite, new_params, state.params)
+        new_opt = tree_select(finite, new_opt, state.opt_state)
+        batch_stats = tree_select(finite, batch_stats, state.batch_stats)
+        if extra_vars is not None:
+            new_ev = tree_select(finite, new_ev, state.extra_vars)
+    new_state = state.replace(
+        step=state.step + 1,
+        params=new_params,
+        batch_stats=batch_stats,
+        opt_state=new_opt,
+        extra_vars=new_ev,
+        loss_scale=new_ls if ls is not None else None,
+    )
+    return new_state, precision_metrics(new_state)
+
+
 # --------------------------------------------------------------- DCGAN
 
 
@@ -80,8 +145,11 @@ def create_dcgan_state(
     generator, discriminator, *, noise_dim: int = 100,
     lr: float = 1e-4, rng: int | jax.Array = 0,
     sample_image_shape=(28, 28, 1),
+    policy=None,
 ) -> GANState:
-    """Both Adams at 1e-4 (ref: DCGAN/tensorflow/main.py:31-32)."""
+    """Both Adams at 1e-4 (ref: DCGAN/tensorflow/main.py:31-32).
+    ``policy`` (core/precision.MixedPolicy) attaches the shared
+    DynamicLossScale when the precision policy scales the loss."""
     if isinstance(rng, int):
         rng = jax.random.key(rng)
     kg, kd = jax.random.split(rng)
@@ -107,6 +175,8 @@ def create_dcgan_state(
         g_tx=g_tx,
         d_tx=d_tx,
         noise_dim=noise_dim,
+        loss_scale=(policy.make_loss_scale() if policy is not None
+                    else None),
     )
 
 
@@ -143,11 +213,13 @@ def dcgan_train_step(state: GANState, batch: dict, key: jax.Array,
             state.params["discriminator"], fake, kdrop_fake,
             state.batch_stats["discriminator"],
         )
-        return _bce(fake_logits, True), (
+        loss = _bce(fake_logits, True)
+        return state.scale_loss(loss), (
+            loss,
             g_mut.get("batch_stats", state.batch_stats["generator"]), fake
         )
 
-    (g_loss, (g_stats, fake)), g_grads = jax.value_and_grad(
+    (_, (g_loss, g_stats, fake)), g_grads = jax.value_and_grad(
         g_loss_fn, has_aux=True
     )(state.params["generator"])
 
@@ -160,31 +232,21 @@ def dcgan_train_step(state: GANState, batch: dict, key: jax.Array,
         )
         loss = (_bce(real_logits, True, smooth=label_smooth)
                 + _bce(fake_logits, False))
-        return loss, d_stats
+        return state.scale_loss(loss), (loss, d_stats)
 
-    (d_loss, d_stats), d_grads = jax.value_and_grad(
+    (_, (d_loss, d_stats)), d_grads = jax.value_and_grad(
         d_loss_fn, has_aux=True
     )(state.params["discriminator"])
 
-    g_up, g_opt = state.g_tx.update(
-        g_grads, state.opt_state["generator"], state.params["generator"]
-    )
-    d_up, d_opt = state.d_tx.update(
-        d_grads, state.opt_state["discriminator"],
-        state.params["discriminator"],
-    )
-    new_state = state.replace(
-        step=state.step + 1,
-        params={
-            "generator": optax.apply_updates(state.params["generator"], g_up),
-            "discriminator": optax.apply_updates(
-                state.params["discriminator"], d_up
-            ),
-        },
+    new_state, mp = _gan_apply_gradients(
+        state, g_grads, d_grads,
+        g_params=state.params["generator"],
+        d_params=state.params["discriminator"],
         batch_stats={"generator": g_stats, "discriminator": d_stats},
-        opt_state={"generator": g_opt, "discriminator": d_opt},
+        assemble=lambda new_gp, new_dp: {"generator": new_gp,
+                                         "discriminator": new_dp},
     )
-    return new_state, {"g_loss": g_loss, "d_loss": d_loss}
+    return new_state, {"g_loss": g_loss, "d_loss": d_loss, **mp}
 
 
 def dcgan_sample(state: GANState, key: jax.Array, n: int = 16):
@@ -251,7 +313,7 @@ def pool_query(pool: dict, images: jnp.ndarray, key: jax.Array):
 def create_cyclegan_state(
     generator, discriminator, *, image_size: int = 256,
     lr_schedule=2e-4, beta1: float = 0.5, pool_size: int = POOL_SIZE,
-    rng: int | jax.Array = 0,
+    rng: int | jax.Array = 0, policy=None,
 ) -> GANState:
     """Two Adams (β1=0.5) over {G_a2b+G_b2a} and {D_a+D_b}
     (ref: CycleGAN/tensorflow/train.py:122-127); ``lr_schedule`` may be a
@@ -285,6 +347,8 @@ def create_cyclegan_state(
         d_apply=discriminator.apply,
         g_tx=g_tx,
         d_tx=d_tx,
+        loss_scale=(policy.make_loss_scale() if policy is not None
+                    else None),
     )
 
 
@@ -358,16 +422,12 @@ def cyclegan_train_step(state: GANState, batch: dict, key: jax.Array):
             "loss_id_a2b": loss_id_a2b, "loss_id_b2a": loss_id_b2a,
             "loss_gen_total": total,
         }
-        return total, (s, fake_a2b, fake_b2a, metrics)
+        return state.scale_loss(total), (s, fake_a2b, fake_b2a, metrics)
 
     gp = {k: state.params[k] for k in ("gen_a2b", "gen_b2a")}
     (_, (stats1, fake_a2b, fake_b2a, g_metrics)), g_grads = (
         jax.value_and_grad(g_loss_fn, has_aux=True)(gp)
     )
-    g_up, g_opt = state.g_tx.update(
-        g_grads, state.opt_state["generator"], gp
-    )
-    new_gp = optax.apply_updates(gp, g_up)
 
     # ---- Pool query on the fresh fakes (ref: train.py:251-252)
     pooled_a2b, pool_a2b = pool_query(
@@ -389,26 +449,21 @@ def cyclegan_train_step(state: GANState, batch: dict, key: jax.Array):
         loss_a = (_lsgan(ra, True) + _lsgan(fa, False)) * 0.5
         loss_b = (_lsgan(rb, True) + _lsgan(fb, False)) * 0.5
         total = loss_a + loss_b
-        return total, (s, {"loss_dis_a": loss_a, "loss_dis_b": loss_b,
-                           "loss_dis_total": total})
+        return state.scale_loss(total), (
+            s, {"loss_dis_a": loss_a, "loss_dis_b": loss_b,
+                "loss_dis_total": total})
 
     dp = {k: state.params[k] for k in ("dis_a", "dis_b")}
     (_, (stats2, d_metrics)), d_grads = jax.value_and_grad(
         d_loss_fn, has_aux=True
     )(dp)
-    d_up, d_opt = state.d_tx.update(
-        d_grads, state.opt_state["discriminator"], dp
-    )
-    new_dp = optax.apply_updates(dp, d_up)
-
-    new_state = state.replace(
-        step=state.step + 1,
-        params={**new_gp, **new_dp},
+    new_state, mp = _gan_apply_gradients(
+        state, g_grads, d_grads, g_params=gp, d_params=dp,
         batch_stats=stats2,
-        opt_state={"generator": g_opt, "discriminator": d_opt},
+        assemble=lambda new_gp, new_dp: {**new_gp, **new_dp},
         extra_vars={"pool_a2b": pool_a2b, "pool_b2a": pool_b2a},
     )
-    return new_state, {**g_metrics, **d_metrics}
+    return new_state, {**g_metrics, **d_metrics, **mp}
 
 
 def cyclegan_translate(state: GANState, images, direction: str = "a2b"):

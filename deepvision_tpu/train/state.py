@@ -7,6 +7,15 @@ holding params + BN batch_stats + optax optimizer state + step counter. The
 ``loggers`` metric history stays host-side (train/loggers.py) and is saved
 next to the state by the Orbax checkpointer, preserving the reference's
 "curves live inside the checkpoint" workflow.
+
+Mixed precision (core/precision.py): parameters here are the f32
+MASTERS — layers cast them to the compute dtype at use. When the policy
+enables dynamic loss scaling the :class:`DynamicLossScale` state rides
+the ``loss_scale`` field (``None`` otherwise — an empty pytree, so
+f32-era states flatten identically), and :meth:`TrainState.apply_gradients`
+owns the unscale → finiteness check → skip-or-update select: a
+non-finite-grad step backs the scale off and leaves master weights AND
+optimizer state untouched.
 """
 
 from __future__ import annotations
@@ -18,6 +27,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from deepvision_tpu.core.precision import (
+    MixedPolicy,
+    all_finite,
+    tree_select,
+)
+
 
 @flax.struct.dataclass
 class TrainState:
@@ -28,18 +43,59 @@ class TrainState:
     # Static (non-pytree) fields:
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    # DynamicLossScale when the precision policy scales the loss; None
+    # (an EMPTY pytree — leaf list unchanged for every pre-policy
+    # checkpoint and donation-alignment contract) otherwise.
+    loss_scale: Any = None
 
     def apply_gradients(self, grads, *, batch_stats=None) -> "TrainState":
+        if self.loss_scale is None:
+            updates, new_opt_state = self.tx.update(
+                grads, self.opt_state, self.params
+            )
+            new_params = optax.apply_updates(self.params, updates)
+            return self.replace(
+                step=self.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                batch_stats=self.batch_stats if batch_stats is None
+                else batch_stats,
+            )
+        # dynamic loss scaling: grads arrive SCALED from the backward —
+        # divide the scale back out (and cast up to the f32 masters),
+        # then gate the whole update on grad finiteness: a non-finite
+        # step is SKIPPED (masters, optimizer state and BN stats all
+        # keep their pre-step values) while the scale backs off.
+        ls = self.loss_scale
+        grads = ls.unscale(grads)
+        finite = all_finite(grads)
+        new_ls = ls.adjust(finite)
+        # the optimizer still runs unconditionally (one traced program,
+        # no lax.cond over the whole update — XLA fuses the selects);
+        # non-finite grads are zeroed first so the update math cannot
+        # poison opt_state moments with inf*0 NaNs before the select.
+        safe_grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
         updates, new_opt_state = self.tx.update(
-            grads, self.opt_state, self.params
+            safe_grads, self.opt_state, self.params
         )
         new_params = optax.apply_updates(self.params, updates)
+        new_bs = self.batch_stats if batch_stats is None else batch_stats
         return self.replace(
             step=self.step + 1,
-            params=new_params,
-            opt_state=new_opt_state,
-            batch_stats=self.batch_stats if batch_stats is None else batch_stats,
+            params=tree_select(finite, new_params, self.params),
+            opt_state=tree_select(finite, new_opt_state, self.opt_state),
+            batch_stats=tree_select(finite, new_bs, self.batch_stats)
+            if batch_stats is not None else self.batch_stats,
+            loss_scale=new_ls,
         )
+
+    def scale_loss(self, loss: jax.Array) -> jax.Array:
+        """Loss scaled for the backward (identity without a scaler) —
+        the one call sites multiply in before ``value_and_grad``."""
+        if self.loss_scale is None:
+            return loss
+        return self.loss_scale.scale_loss(loss)
 
 
 def create_train_state(
@@ -49,12 +105,18 @@ def create_train_state(
     *,
     rng: jax.Array | int = 0,
     train_kwarg: bool = True,
+    policy: MixedPolicy | None = None,
 ) -> TrainState:
     """Initialize params/batch_stats from a sample batch and wrap with ``tx``.
 
     Initialization runs in TRAIN mode so lazily-created training-only
     submodules (Inception aux classifiers — ref:
     Inception/pytorch/models/inception_v1.py:92-113) get parameters.
+
+    ``policy`` (core/precision.py): attaches the dynamic loss-scale
+    state when the policy calls for it. The model's compute dtype is
+    the module's own ``dtype`` attribute (set at construction from the
+    same policy) — parameters are initialized in f32 masters either way.
     """
     if isinstance(rng, int):
         rng = jax.random.key(rng)
@@ -72,4 +134,6 @@ def create_train_state(
         opt_state=tx.init(params),
         apply_fn=model.apply,
         tx=tx,
+        loss_scale=policy.make_loss_scale() if policy is not None
+        else None,
     )

@@ -13,6 +13,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from deepvision_tpu.core.precision import precision_metrics
 from deepvision_tpu.ops.normalize import maybe_normalize
 from deepvision_tpu.losses.classification import (
     softmax_cross_entropy,
@@ -72,13 +73,17 @@ def classification_train_step(
         else:
             logits = out
             loss = mixed_ce(logits)
-        return loss, (logits, mutated.get("batch_stats", state.batch_stats))
+        # backward runs on the (possibly loss-scaled) value; the RAW
+        # loss rides the aux so metrics never report the scaled number
+        return state.scale_loss(loss), (
+            loss, logits, mutated.get("batch_stats", state.batch_stats))
 
-    (loss, (logits, new_bs)), grads = jax.value_and_grad(
+    (_, (loss, logits, new_bs)), grads = jax.value_and_grad(
         loss_fn, has_aux=True
     )(state.params)
     new_state = state.apply_gradients(grads, batch_stats=new_bs)
-    metrics = {"loss": loss, **topk_accuracy(logits, labels)}
+    metrics = {"loss": loss, **topk_accuracy(logits, labels),
+               **precision_metrics(new_state)}
     return new_state, metrics
 
 
@@ -113,13 +118,15 @@ def yolo_train_step(state: TrainState, batch: dict, key: jax.Array):
         parts = yolo_loss(y_true, preds, num_classes,
                           true_boxes_xywh=boxes)
         loss = jnp.mean(parts["loss"])
-        return loss, (parts, mutated.get("batch_stats", state.batch_stats))
+        return state.scale_loss(loss), (
+            parts, mutated.get("batch_stats", state.batch_stats))
 
-    (loss, (parts, new_bs)), grads = jax.value_and_grad(
+    (_, (parts, new_bs)), grads = jax.value_and_grad(
         loss_fn, has_aux=True
     )(state.params)
     new_state = state.apply_gradients(grads, batch_stats=new_bs)
     metrics = {k: jnp.mean(v) for k, v in parts.items()}
+    metrics.update(precision_metrics(new_state))
     return new_state, metrics
 
 
@@ -204,13 +211,15 @@ def pose_train_step(state: TrainState, batch: dict, key: jax.Array):
             mutable=["batch_stats"],
         )
         loss = weighted_heatmap_mse(targets, outputs)
-        return loss, mutated.get("batch_stats", state.batch_stats)
+        return state.scale_loss(loss), (
+            loss, mutated.get("batch_stats", state.batch_stats))
 
-    (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+    (_, (loss, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params
     )
     new_state = state.apply_gradients(grads, batch_stats=new_bs)
-    return new_state, {"loss": loss}
+    return new_state, {"loss": loss,
+                       **precision_metrics(new_state)}
 
 
 def pose_eval_step(state: TrainState, batch: dict) -> dict:
@@ -261,14 +270,14 @@ def centernet_train_step(state: TrainState, batch: dict, key: jax.Array):
         num_classes = outputs[0][0].shape[-1]
         targets = encode_centernet(boxes, labels, num_classes, grid)
         parts = centernet_loss(targets, outputs)
-        return parts["loss"], (parts, mutated.get("batch_stats",
-                                                  state.batch_stats))
+        return state.scale_loss(parts["loss"]), (
+            parts, mutated.get("batch_stats", state.batch_stats))
 
-    (loss, (parts, new_bs)), grads = jax.value_and_grad(
+    (_, (parts, new_bs)), grads = jax.value_and_grad(
         loss_fn, has_aux=True
     )(state.params)
     new_state = state.apply_gradients(grads, batch_stats=new_bs)
-    return new_state, parts
+    return new_state, {**parts, **precision_metrics(new_state)}
 
 
 def centernet_eval_step(state: TrainState, batch: dict) -> dict:

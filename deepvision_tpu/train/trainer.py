@@ -185,7 +185,14 @@ class Trainer:
         sample = np.zeros(
             (1, size, size, config.get("channels", 3)), np.float32
         )
-        self.state = create_train_state(model, self.tx, sample, rng=seed)
+        # numerics policy (core/precision.py): the config's explicit
+        # "precision" declaration (train.py resolves CLI > config);
+        # a scaling policy attaches the DynamicLossScale to the state
+        from deepvision_tpu.core.precision import get_policy
+
+        self.policy = get_policy(config.get("precision", "bf16"))
+        self.state = create_train_state(model, self.tx, sample, rng=seed,
+                                        policy=self.policy)
         # self-healing (resilience/): with a RecoveryPolicy the checkify
         # NaN/Inf tripwire becomes rollback-and-skip instead of a crash,
         # transient data reads retry with backoff, and resume verifies

@@ -414,3 +414,73 @@ def test_ircheck_heavy_families_live():
         rep = check_case(cases[name], cfg)
         assert rep["ok"], (name, rep["failures"])
         assert rep["stability_diffs"] == []
+
+
+# ------------------------------------------- wire ledger + diet (ISSUE 15)
+
+
+def test_wire_ledger_gates_with_same_band():
+    """The backend-neutral wire ledger rides the [[ircheck.hbm]] rows:
+    above-band fails, below-band nudges, missing wire field notes."""
+    import jax
+
+    from tools.jaxlint.ircheck import check_case as cc
+
+    case = _toy_case()
+    measured = cc(case, IRCheckConfig())["wire_gb_per_step"]
+
+    def cfg_with(wire):
+        cfg = IRCheckConfig()
+        rep0 = cc(case, IRCheckConfig())
+        cfg.hbm.append(HbmBaseline(
+            model="toy", platform=jax.default_backend(), batch=4,
+            mesh="1x1", hbm_gb_per_step=rep0.get("hbm_gb_per_step",
+                                                 0.012),
+            wire_gb_per_step=wire))
+        return cfg
+
+    rep = cc(case, cfg_with(measured))
+    assert rep["ok"], rep["failures"]
+    rep = cc(case, cfg_with(measured / 2))  # regression: fail
+    assert any("wire_gb_per_step" in f and "ratchets DOWN" in f
+               for f in rep["failures"])
+    rep = cc(case, cfg_with(measured * 3))  # improvement: nudge
+    assert rep["ok"]
+    assert any("wire bytes improved" in n for n in rep["notes"])
+
+
+def test_diet_twin_fires_below_declared_floor():
+    """--diet traces the f32 twin and asserts the declared reduction
+    floor; a case whose policy IS f32 shows ~0 reduction and must fail
+    an (artificial) 40% floor — and pass with no declared target."""
+    from tools.jaxlint.config import DietTarget
+    from tools.jaxlint.ircheck import check_case as cc
+
+    case = _toy_case()  # its build ignores precision: ~0% reduction
+    rep = cc(case, IRCheckConfig(), diet=True)
+    assert rep["ok"], rep["failures"]  # no target declared: informative
+    assert abs(rep["diet_reduction"]) < 0.01
+    cfg = IRCheckConfig()
+    cfg.diet.append(DietTarget(model="toy", min_reduction=0.4,
+                               reason="test fixture"))
+    rep = cc(case, cfg, diet=True)
+    assert not rep["ok"]
+    assert any("below the declared floor" in f for f in rep["failures"])
+
+
+def test_diet_live_lenet_f32_case_reports_zero():
+    """lenet5's shipped policy IS f32 (mnist parity floor): the diet
+    twin must agree with itself — the honest zero in the median."""
+    cfg = load_ircheck_config(REPO_TOML)
+    rep = check_case(make_cases()["lenet5"], cfg, diet=True)
+    assert rep["ok"], rep["failures"]
+    assert abs(rep["diet_reduction"]) < 0.01
+
+
+def test_diet_live_dcgan_reduction_positive():
+    """Slow-tier live diet: the dcgan composite's bf16 policy must
+    show a real wire reduction vs its f32 twin."""
+    cfg = load_ircheck_config(REPO_TOML)
+    rep = check_case(make_cases()["dcgan"], cfg, diet=True)
+    assert rep["ok"], rep["failures"]
+    assert rep["diet_reduction"] > 0.10, rep["diet_reduction"]

@@ -1869,6 +1869,93 @@ def test_load_config_reads_span_funcs(tmp_path):
     assert "*_train_step" in LintConfig().span_funcs
 
 
+# ----------------------------------------------------------- JX123
+
+
+def test_jx123_flags_raw_f32_cast_and_literal_arrays(tmp_path):
+    r = lint(tmp_path, "models/net.py", """
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class Net(nn.Module):
+            dtype: object = jnp.bfloat16
+
+            def __call__(self, x, train=False):
+                y = x.astype(jnp.float32)          # raw cast: flagged
+                z = jnp.zeros(x.shape, jnp.float32)  # f32 literal array
+                w = jnp.ones(x.shape, dtype="float32")  # string form
+                return y + z + w
+        """)
+    assert codes(r) == ["JX123", "JX123", "JX123"]
+    assert "bypasses the numerics policy" in r.findings[0].message
+
+
+def test_jx123_flags_f32_cast_in_loss_body(tmp_path):
+    r = lint(tmp_path, "losses/det.py", """
+        import jax.numpy as jnp
+
+        def fancy_loss(pred, target):
+            return jnp.mean((pred.astype(jnp.float32) - target) ** 2)
+        """)
+    assert codes(r) == ["JX123"]
+
+
+def test_jx123_passes_policy_derived_dtypes(tmp_path):
+    r = lint(tmp_path, "models/net.py", """
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class Net(nn.Module):
+            dtype: object = jnp.bfloat16
+
+            def __call__(self, x, train=False):
+                hd = jnp.promote_types(self.dtype, jnp.float32)
+                y = x.astype(self.dtype)        # compute dtype: fine
+                z = x.astype(hd)                # precision floor: fine
+                w = jnp.zeros(x.shape, self.dtype)
+                return y + z.astype(self.dtype) + w
+        """)
+    assert codes(r) == []
+
+
+def test_jx123_skips_host_data_pipelines(tmp_path):
+    # data/ transforms legitimately produce f32 on the host — the WIRE
+    # dtype is JX114's beat, not the in-graph policy's
+    r = lint(tmp_path, "data/tf.py", """
+        class Transform:
+            def __call__(self, img):
+                return img.astype("float32") / 255.0
+        """)
+    assert codes(r) == []
+
+
+def test_jx123_precision_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(precision_funcs=["hot_body*"])
+    r = lint(tmp_path, "lib/ops.py", """
+        import jax.numpy as jnp
+
+        def hot_body_fn(x):
+            return x.astype(jnp.float32)      # matched by the knob
+
+        def cold_path(x):
+            return x.astype(jnp.float32)      # not matched
+        """, cfg=cfg)
+    assert codes(r) == ["JX123"]
+
+
+def test_load_config_reads_precision_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        precision_funcs = ["hot_body*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.precision_funcs == ["hot_body*"]
+    assert "__call__" in LintConfig().precision_funcs
+
+
 # ------------------------------- concurrency tier (ISSUE 14, JX118-122)
 
 

@@ -1,0 +1,590 @@
+"""Mixed-precision policy engine (ISSUE 15): dynamic loss scaling
+units, the non-finite skip contract, checkpoint round-trip through the
+PR 4 manifest machinery, the PR 10 sentinel composition, bf16-vs-f32
+numerics twins per family at pinned tolerance, remat declarations, and
+the backend-neutral wire-bytes ledger helper."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepvision_tpu.core.precision import (
+    DynamicLossScale,
+    all_finite,
+    get_policy,
+    precision_metrics,
+    tree_select,
+)
+from deepvision_tpu.train.state import TrainState, create_train_state
+
+# bf16-vs-f32 twin tolerances (pinned; measured on this box's seeds —
+# bf16 carries ~2^-8 relative rounding per op, the trajectories track
+# well inside these bands at the pinned step counts)
+CLS_LOSS_RTOL = 0.05     # classification per-step loss agreement
+# heatmap MSE at random init is the least conditioned surface in the
+# zoo (foreground-weighted squared error over noisy outputs amplifies
+# bf16 rounding): measured 12% per-step drift at the pinned seeds, so
+# the documented band is 20% — the DECISION gate (identical decoded
+# argmax) is the strict half of the pose twin
+POSE_LOSS_RTOL = 0.20
+DET_LOSS_RTOL = 0.10     # multi-part detection loss agreement
+GAN_LOSS_RTOL = 0.15     # two-network coupled losses drift fastest
+
+
+# ----------------------------------------------------- loss-scale units
+
+
+def test_loss_scale_grow_backoff_schedule():
+    ls = DynamicLossScale.create(init_scale=1024.0, growth_interval=2)
+    t, f = jnp.bool_(True), jnp.bool_(False)
+    ls = ls.adjust(t)  # good streak 1
+    assert float(ls.scale) == 1024.0 and int(ls.good_steps) == 1
+    assert float(ls.last_finite) == 1.0
+    ls = ls.adjust(t)  # streak hits growth_interval -> double, reset
+    assert float(ls.scale) == 2048.0 and int(ls.good_steps) == 0
+    ls = ls.adjust(f)  # non-finite -> halve, streak reset
+    assert float(ls.scale) == 1024.0 and int(ls.good_steps) == 0
+    assert float(ls.last_finite) == 0.0
+
+
+def test_loss_scale_clamps_at_min_and_max():
+    ls = DynamicLossScale.create(init_scale=2.0, growth_interval=1,
+                                 min_scale=1.0, max_scale=4.0)
+    ls = ls.adjust(jnp.bool_(True))
+    assert float(ls.scale) == 4.0
+    ls = ls.adjust(jnp.bool_(True))  # capped
+    assert float(ls.scale) == 4.0
+    assert float(ls.last_finite) == 1.0  # clamp must not read as backoff
+    for _ in range(5):
+        ls = ls.adjust(jnp.bool_(False))
+    assert float(ls.scale) == 1.0  # floored
+    assert float(ls.last_finite) == 0.0  # floor must still read backoff
+
+
+def test_loss_scale_scale_and_unscale_are_exact_inverses():
+    ls = DynamicLossScale.create(init_scale=float(2 ** 15))
+    grads = {"w": jnp.asarray([1.5, -2.25, 3e-4], jnp.bfloat16)}
+    scaled = jax.tree.map(lambda g: g * ls.scale.astype(g.dtype), grads)
+    back = ls.unscale(scaled)
+    # powers of two scale exactly in binary floating point — and the
+    # unscale casts up to the f32 masters
+    assert back["w"].dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(back["w"]),
+        np.asarray(grads["w"].astype(jnp.float32)))
+
+
+def test_all_finite_and_tree_select():
+    good = {"a": jnp.ones(3), "b": jnp.zeros((), jnp.int32)}
+    assert bool(all_finite(good))
+    bad = {"a": jnp.asarray([1.0, jnp.inf, 0.0]), "b": good["b"]}
+    assert not bool(all_finite(bad))
+    sel = tree_select(jnp.bool_(False), bad, good)
+    np.testing.assert_array_equal(np.asarray(sel["a"]), np.ones(3))
+
+
+def test_get_policy_names_and_aliases():
+    assert get_policy("bf16").compute_dtype == jnp.bfloat16
+    assert not get_policy("bf16").loss_scaling
+    assert get_policy("bf16_scaled").loss_scaling
+    assert get_policy("f32").compute_dtype == jnp.float32
+    assert get_policy("bfloat16").name == "bf16"
+    assert get_policy("mixed_scaled").name == "bf16_scaled"
+    with pytest.raises(ValueError, match="unknown precision"):
+        get_policy("fp8")
+
+
+def test_every_shipped_config_declares_a_valid_policy():
+    from deepvision_tpu.train.configs import TRAINING_CONFIG, get_config
+
+    for name in TRAINING_CONFIG:
+        cfg = get_config(name)
+        get_policy(cfg["precision"])  # raises on an invalid name
+        assert "precision" in TRAINING_CONFIG[name], (
+            f"{name} must DECLARE precision explicitly — the table is "
+            "the source of truth the CLI doc defers to")
+
+
+# ------------------------------------------------ TrainState integration
+
+
+def _tiny_state(policy=None):
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(4, dtype=jnp.float32)(x)
+
+    return create_train_state(
+        Tiny(), optax.sgd(0.1, momentum=0.9),
+        np.zeros((1, 3, 3, 1), np.float32), policy=policy)
+
+
+def test_plain_state_has_empty_loss_scale_pytree():
+    s0 = _tiny_state()
+    assert s0.loss_scale is None
+    s1 = _tiny_state(policy=get_policy("bf16"))  # no scaling either
+    assert s1.loss_scale is None
+    # leaf lists identical -> checkpoints/donation alignment unchanged
+    assert len(jax.tree.leaves(s0)) == len(jax.tree.leaves(s1))
+
+
+def test_nonfinite_grads_skip_update_and_back_off():
+    state = _tiny_state(policy=get_policy("bf16_scaled"))
+    scale0 = float(state.loss_scale.scale)
+    grads = jax.tree.map(jnp.ones_like, state.params)
+    bad = jax.tree.map(lambda g: g * jnp.inf, grads)
+    new = state.apply_gradients(bad)
+    # masters AND optimizer state untouched; step counted; scale halved
+    for a, b in zip(jax.tree.leaves(new.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(new.opt_state),
+                    jax.tree.leaves(state.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(new.step) == int(state.step) + 1
+    assert float(new.loss_scale.scale) == scale0 / 2
+    mp = precision_metrics(new)
+    assert float(mp["mp_grads_finite"]) == 0.0
+
+    # a finite step then applies normally (grads arrive pre-scaled)
+    scaled = jax.tree.map(
+        lambda g: g * new.loss_scale.scale.astype(g.dtype), grads)
+    newer = new.apply_gradients(scaled)
+    assert float(precision_metrics(newer)["mp_grads_finite"]) == 1.0
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(newer.params),
+                        jax.tree.leaves(new.params)))
+    assert moved
+
+
+def test_scaled_update_bit_matches_unscaled_at_pow2_scale():
+    """The whole point of master weights: with a power-of-two scale the
+    scaled-backward/unscaled-update path must reproduce the plain f32
+    update BIT-FOR-BIT."""
+    plain = _tiny_state()
+    scaled = _tiny_state(policy=get_policy("bf16_scaled"))
+    grads = jax.tree.map(
+        lambda p: jnp.full_like(p, 0.125), plain.params)
+    up_plain = plain.apply_gradients(grads)
+    pre = jax.tree.map(
+        lambda g: g * scaled.loss_scale.scale.astype(g.dtype), grads)
+    up_scaled = scaled.apply_gradients(pre)
+    for a, b in zip(jax.tree.leaves(up_plain.params),
+                    jax.tree.leaves(up_scaled.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scale_state_survives_checkpoint_roundtrip(tmp_path):
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+
+    state = _tiny_state(policy=get_policy("bf16_scaled"))
+    state = state.replace(
+        loss_scale=state.loss_scale.replace(
+            scale=jnp.float32(4096.0),
+            good_steps=jnp.asarray(7, jnp.int32)))
+    mgr = CheckpointManager(tmp_path / "ckpt", integrity=True)
+    try:
+        mgr.save(0, state)
+        mgr.wait_until_finished()
+        template = _tiny_state(policy=get_policy("bf16_scaled"))
+        restored, meta = mgr.restore(template, 0)
+    finally:
+        mgr.close()
+    assert float(restored.loss_scale.scale) == 4096.0
+    assert int(restored.loss_scale.good_steps) == 7
+
+
+def test_pre_policy_checkpoint_restores_under_scaling(tmp_path):
+    """MIGRATION: a checkpoint saved BEFORE the config declared a
+    scaling policy (no loss_scale item on disk) must restore under the
+    new bf16_scaled default — state restored, fresh scale kept — not
+    hard-crash until the operator guesses --precision f32 (the
+    hourglass104 upgrade path)."""
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+
+    old = _tiny_state()  # pre-policy: no loss_scale saved
+    old = old.replace(step=jnp.asarray(5, jnp.int32))
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    try:
+        mgr.save(0, old)
+        mgr.wait_until_finished()
+        template = _tiny_state(policy=get_policy("bf16_scaled"))
+        restored, meta = mgr.restore(template, 0)
+    finally:
+        mgr.close()
+    assert int(restored.step) == 5  # the real state came back
+    assert restored.loss_scale is not None  # fresh scale state kept
+    assert float(restored.loss_scale.scale) == float(2 ** 15)
+
+
+def test_mixed_batchnorm_honors_use_fast_variance():
+    """use_fast_variance=False (the two-pass formula, chosen for
+    large-mean activations where E[x²]-E[x]² cancels) must survive the
+    mixed-stats branch: at mean≫std the fast formula collapses var to
+    the clamp while the two-pass keeps it."""
+    from deepvision_tpu.models.layers import MixedBatchNorm
+
+    rng = np.random.default_rng(0)
+    # mean 300, std 0.05: mean²=9e4 vs var 2.5e-3 — an 8-digit gap
+    # bf16's 8-bit mantissa cannot carry through E[x²]-E[x]²
+    x = jnp.asarray(rng.normal(300.0, 0.05, (8, 4, 4, 8)), jnp.float32)
+    slow = MixedBatchNorm(use_running_average=False, momentum=0.9,
+                          epsilon=1e-5, dtype=jnp.bfloat16,
+                          use_fast_variance=False)
+    v = slow.init(jax.random.key(0), x)
+    _, mut = slow.apply(v, x, mutable=["batch_stats"])
+    var = np.asarray(mut["batch_stats"]["var"])
+    # two-pass: variance of the bf16-rounded data around its mean —
+    # dominated by bf16 quantization of 300-magnitude values (~0.5²),
+    # but finite and nonzero; the fast formula here returns garbage
+    # cancellation (clamped zeros or hugely wrong values)
+    assert np.all(var > 0), var
+    assert np.all(var < 10.0), var
+
+
+def test_sentinel_treats_scale_backoff_as_handled():
+    from deepvision_tpu.obs.metrics import Registry
+    from deepvision_tpu.resilience.sentinel import (
+        SentinelMonitor,
+        SentinelTrip,
+    )
+
+    reg = Registry()
+    mon = SentinelMonitor(z_threshold=4.0, warmup=2, registry=reg)
+    for i in range(8):  # warm the detector on a steady series
+        mon.observe(0, i, {"loss": 1.0, "mp_grads_finite": 1.0})
+    # a backoff step: loss is garbage (inf) but the scaler already
+    # caught and skipped it — NOT a trip, counted separately
+    mon.observe(0, 8, {"loss": float("inf"), "mp_grads_finite": 0.0})
+    assert mon.scale_backoffs.value == 1
+    assert mon.trips.value == 0
+    # the SAME garbage without the backoff verdict IS a trip
+    with pytest.raises(SentinelTrip):
+        mon.observe(0, 9, {"loss": float("inf"),
+                           "mp_grads_finite": 1.0})
+    assert mon.trips.value == 1
+
+
+def test_classification_step_emits_mp_metrics():
+    from functools import partial
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.steps import classification_train_step
+
+    policy = get_policy("bf16_scaled")
+    model = get_model("lenet5", num_classes=10,
+                      dtype=policy.compute_dtype)
+    state = create_train_state(model, optax.adam(1e-3),
+                               np.zeros((1, 32, 32, 1), np.float32),
+                               policy=policy)
+    batch = {"image": np.random.default_rng(0).normal(
+        size=(8, 32, 32, 1)).astype(np.float32),
+        "label": np.arange(8, dtype=np.int32) % 10}
+    step = jax.jit(partial(classification_train_step,
+                           normalize_kind="imagenet"))
+    new_state, metrics = step(state, batch, jax.random.key(0))
+    assert float(metrics["mp_grads_finite"]) == 1.0
+    assert float(metrics["mp_loss_scale"]) == float(2 ** 15)
+    # the reported loss is the RAW loss, not the scaled one
+    assert float(metrics["loss"]) < 100.0
+
+
+# ------------------------------------------------------- numerics twins
+
+
+def _twin_states(model_f32, model_bf16, tx_factory, sample, policy):
+    """Two states sharing IDENTICAL f32 master params (bf16 vs f32 is
+    a compute-dtype difference, never an init difference)."""
+    s32 = create_train_state(model_f32, tx_factory(), sample, rng=0)
+    s16 = create_train_state(model_bf16, tx_factory(), sample, rng=0,
+                             policy=policy)
+    s16 = s16.replace(params=s32.params,
+                      batch_stats=s32.batch_stats)
+    return s32, s16
+
+
+def test_bf16_twin_classification_lenet():
+    """Classification family twin: loss trajectory within
+    CLS_LOSS_RTOL and IDENTICAL top-1 decisions on the held-out batch
+    (the acceptance's decision-agreement gate)."""
+    from functools import partial
+
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.steps import classification_train_step
+
+    rng = np.random.default_rng(0)
+    imgs = rng.normal(size=(64, 32, 32, 1)).astype(np.float32)
+    labels = (rng.integers(0, 10, 64)).astype(np.int32)
+    policy = get_policy("bf16")
+    s32, s16 = _twin_states(
+        get_model("lenet5", num_classes=10, dtype=jnp.float32),
+        get_model("lenet5", num_classes=10, dtype=jnp.bfloat16),
+        lambda: optax.adam(1e-3),
+        imgs[:1], policy)
+    step = jax.jit(partial(classification_train_step,
+                           normalize_kind="imagenet"))
+    key = jax.random.key(1)
+    for i in range(10):
+        b = {"image": imgs[(i * 16) % 48:(i * 16) % 48 + 16],
+             "label": labels[(i * 16) % 48:(i * 16) % 48 + 16]}
+        key, sub = jax.random.split(key)
+        s32, m32 = step(s32, b, sub)
+        s16, m16 = step(s16, b, sub)
+        assert float(m16["loss"]) == pytest.approx(
+            float(m32["loss"]), rel=CLS_LOSS_RTOL), f"step {i}"
+    held = {"image": imgs[48:], "label": labels[48:]}
+    logits32 = s32.apply_fn(
+        {"params": s32.params, "batch_stats": s32.batch_stats},
+        held["image"], train=False)
+    logits16 = s16.apply_fn(
+        {"params": s16.params, "batch_stats": s16.batch_stats},
+        held["image"], train=False)
+    np.testing.assert_array_equal(
+        np.argmax(np.asarray(logits32), -1),
+        np.argmax(np.asarray(logits16), -1))
+
+
+def test_bf16_twin_pose_hourglass():
+    """Pose family twin at the shipped bf16_scaled policy (f32 carrier
+    + MixedBatchNorm + loss scaling + stack remat) vs the f32 program:
+    heatmap-MSE trajectory within POSE_LOSS_RTOL and identical
+    decoded-argmax decisions on the training batch. A reduced
+    2-stack/64-feature StackedHourglass keeps the grad-through-
+    recursion compile affordable on this box — same recursion depth,
+    same mixed design, same remat transform as the shipped 104."""
+    from deepvision_tpu.models.hourglass import StackedHourglass
+    from deepvision_tpu.train.steps import pose_train_step
+
+    def hg(dtype, remat=None):
+        return StackedHourglass(num_stacks=1, num_residual=1,
+                                num_heatmaps=3, features=64,
+                                dtype=dtype, remat=remat)
+
+    rng = np.random.default_rng(0)
+    # 64² is the order-4 floor: the stem's /4 leaves a 16² grid and
+    # the recursion pools 16 -> 2 at the bottom
+    imgs = rng.normal(size=(2, 64, 64, 3)).astype(np.float32) * 0.3
+    kx = rng.uniform(2, 14, (2, 3)).astype(np.float32)
+    ky = rng.uniform(2, 14, (2, 3)).astype(np.float32)
+    v = np.ones((2, 3), np.float32)
+    policy = get_policy("bf16_scaled")
+    s32, s16 = _twin_states(
+        hg(jnp.float32),
+        hg(jnp.bfloat16, remat="stack"),
+        lambda: optax.adam(2.5e-4),  # the config-scale pose LR
+        imgs[:1], policy)
+    # DECISION gate first, on the SHARED initial weights: same masters,
+    # bf16 vs f32 forward — this isolates the numerics (what the diet
+    # changes) from trajectory divergence (two optimizers drifting
+    # apart is gated separately, by the loss-rtol band below; comparing
+    # argmaxes of two already-diverged noise maps tests tie-breaking,
+    # not precision). Tie-aware: a disagreeing joint must be a genuine
+    # near-tie of the f32 map (within 2% of its own peak).
+    out32 = s32.apply_fn(
+        {"params": s32.params, "batch_stats": s32.batch_stats},
+        imgs, train=False)[-1]
+    out16 = s16.apply_fn(
+        {"params": s16.params, "batch_stats": s16.batch_stats},
+        imgs, train=False)[-1]
+    f32flat = np.asarray(out32, np.float32).reshape(
+        out32.shape[0], -1, out32.shape[-1])
+    f16flat = np.asarray(out16, np.float32).reshape(
+        out16.shape[0], -1, out16.shape[-1])
+    pick32, pick16 = f32flat.argmax(1), f16flat.argmax(1)
+    for b in range(pick32.shape[0]):
+        for j in range(pick32.shape[1]):
+            if pick32[b, j] == pick16[b, j]:
+                continue
+            peak = f32flat[b, pick32[b, j], j]
+            at16 = f32flat[b, pick16[b, j], j]
+            assert peak - at16 <= 0.02 * max(abs(peak), 1e-6), (
+                f"joint ({b},{j}): bf16 argmax {pick16[b, j]} vs f32 "
+                f"{pick32[b, j]} is a real disagreement "
+                f"({at16} vs peak {peak}), not a near-tie")
+
+    step = jax.jit(pose_train_step)
+    batch = {"image": imgs, "kx": kx, "ky": ky, "v": v}
+    key = jax.random.key(1)
+    for i in range(3):
+        key, sub = jax.random.split(key)
+        s32, m32 = step(s32, batch, sub)
+        s16, m16 = step(s16, batch, sub)
+        assert float(m16["loss"]) == pytest.approx(
+            float(m32["loss"]), rel=POSE_LOSS_RTOL), f"step {i}"
+
+
+def test_bf16_twin_detection_yolo():
+    """Detection family twin at documented rtol: the multi-part YOLO
+    loss tracks its f32 twin over the pinned steps at small geometry
+    (64² input → 8/4/2 grids — the loss structure, not the full-res
+    program, is what bf16 could break)."""
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.steps import yolo_train_step
+
+    rng = np.random.default_rng(0)
+    bs = 2
+    imgs = (rng.uniform(0, 255, (bs, 64, 64, 3))).astype(np.uint8)
+    boxes = np.tile(np.array([0.5, 0.5, 0.4, 0.4], np.float32),
+                    (bs, 4, 1))
+    labels = np.full((bs, 4), -1, np.int32)
+    labels[:, 0] = 1
+    policy = get_policy("bf16")
+    s32, s16 = _twin_states(
+        get_model("yolov3", num_classes=5, dtype=jnp.float32),
+        get_model("yolov3", num_classes=5, dtype=jnp.bfloat16),
+        lambda: optax.adam(1e-3),
+        imgs[:1], policy)
+    step = jax.jit(yolo_train_step)
+    batch = {"image": imgs, "boxes": boxes, "label": labels}
+    key = jax.random.key(1)
+    for i in range(3):
+        key, sub = jax.random.split(key)
+        s32, m32 = step(s32, batch, sub)
+        s16, m16 = step(s16, batch, sub)
+        assert float(m16["loss"]) == pytest.approx(
+            float(m32["loss"]), rel=DET_LOSS_RTOL), f"step {i}"
+
+
+def test_bf16_twin_gan_dcgan():
+    """GAN family twin: both coupled losses within GAN_LOSS_RTOL over
+    the pinned steps (documented rtol per the acceptance)."""
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.gan import (
+        create_dcgan_state,
+        dcgan_train_step,
+    )
+
+    rng = np.random.default_rng(0)
+    reals = (rng.normal(size=(16, 28, 28, 1)) * 0.5).astype(np.float32)
+    policy = get_policy("bf16")
+
+    def build(dtype, pol):
+        return create_dcgan_state(
+            get_model("dcgan_generator", dtype=dtype),
+            get_model("dcgan_discriminator", dtype=dtype),
+            rng=0, policy=pol)
+
+    s32 = build(jnp.float32, None)
+    s16 = build(jnp.bfloat16, policy)
+    s16 = s16.replace(params=s32.params, batch_stats=s32.batch_stats)
+    step = jax.jit(dcgan_train_step)
+    key = jax.random.key(1)
+    for i in range(3):
+        key, sub = jax.random.split(key)
+        s32, m32 = step(s32, {"image": reals}, sub)
+        s16, m16 = step(s16, {"image": reals}, sub)
+        for k in ("g_loss", "d_loss"):
+            assert float(m16[k]) == pytest.approx(
+                float(m32[k]), rel=GAN_LOSS_RTOL), f"step {i} {k}"
+
+
+# ------------------------------------------------- remat + wire ledger
+
+
+def test_registry_declares_remat_policies():
+    from deepvision_tpu.models.registry import model_remat
+
+    assert model_remat("resnet152") == "block"
+    assert model_remat("hourglass104") == "stack"
+    assert model_remat("lenet5") is None
+    assert model_remat("no_such_model") is None
+
+
+def test_config_folds_remat_into_model_kwargs():
+    from deepvision_tpu.train.configs import get_config
+
+    assert get_config("resnet152")["model_kwargs"]["remat"] == "block"
+    assert get_config("hourglass104")["model_kwargs"]["remat"] \
+        == "stack"
+    assert "remat" not in get_config("resnet50").get("model_kwargs", {})
+
+
+def test_hourglass_stack_remat_preserves_params_and_numerics():
+    from deepvision_tpu.models import get_model
+
+    x = np.random.default_rng(0).normal(
+        size=(1, 64, 64, 3)).astype(np.float32)
+    plain = get_model("hourglass104", num_heatmaps=3)
+    remat = get_model("hourglass104", num_heatmaps=3, remat="stack")
+    vp = plain.init(jax.random.key(0), jnp.asarray(x), train=True)
+    vr = remat.init(jax.random.key(0), jnp.asarray(x), train=True)
+    assert jax.tree_util.tree_structure(vp) \
+        == jax.tree_util.tree_structure(vr)
+    op = plain.apply(vp, jnp.asarray(x), train=False)
+    orr = remat.apply(vr, jnp.asarray(x), train=False)
+    for a, b in zip(op, orr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="remat"):
+        get_model("hourglass104", num_heatmaps=3,
+                  remat="bogus").init(jax.random.key(0),
+                                      jnp.asarray(x), train=True)
+
+
+def test_jaxpr_wire_bytes_is_dtype_faithful_and_convert_fused():
+    from tools.jaxlint.ircheck import jaxpr_wire_bytes
+
+    def f32_chain(x):
+        return (x * 2.0 + 1.0).sum()
+
+    def bf16_chain(x):
+        y = x.astype(jnp.bfloat16)
+        return ((y * jnp.bfloat16(2.0)
+                 + jnp.bfloat16(1.0)).astype(jnp.float32)).sum()
+
+    x = jnp.zeros((256, 256), jnp.float32)
+    b32 = jaxpr_wire_bytes(jax.make_jaxpr(f32_chain)(x).jaxpr)
+    b16 = jaxpr_wire_bytes(jax.make_jaxpr(bf16_chain)(x).jaxpr)
+    # the bf16 chain's elementwise traffic is ~half; the converts must
+    # be charged zero (they fuse) or the diet would be invisible
+    assert b16 < 0.75 * b32
+
+
+def test_mixed_batchnorm_f32_path_bit_matches_stock():
+    import flax.linen as nn
+
+    from deepvision_tpu.models.layers import MixedBatchNorm
+
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 8, 8, 16)), jnp.float32)
+    stock = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)
+    mixed = MixedBatchNorm(use_running_average=False, momentum=0.9,
+                           epsilon=1e-5, dtype=jnp.float32)
+    vs = stock.init(jax.random.key(0), x)
+    vm = mixed.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(vs) \
+        == jax.tree_util.tree_structure(vm)
+    ys, ms = stock.apply(vs, x, mutable=["batch_stats"])
+    ym, mm = mixed.apply(vm, x, mutable=["batch_stats"])
+    np.testing.assert_array_equal(np.asarray(ys), np.asarray(ym))
+    assert jax.tree_util.tree_all(jax.tree.map(
+        lambda a, b: bool(jnp.array_equal(a, b)), ms, mm))
+
+
+def test_mixed_batchnorm_bf16_keeps_f32_stats_and_bf16_apply():
+    from deepvision_tpu.models.layers import MixedBatchNorm
+
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(4, 8, 8, 16)), jnp.float32)
+    bn = MixedBatchNorm(use_running_average=False, momentum=0.9,
+                        epsilon=1e-5, dtype=jnp.bfloat16)
+    v = bn.init(jax.random.key(0), x)
+    y, mut = bn.apply(v, x, mutable=["batch_stats"])
+    assert y.dtype == jnp.bfloat16  # the diet's whole point
+    for leaf in jax.tree.leaves(mut["batch_stats"]):
+        assert leaf.dtype == jnp.float32  # statistics stay masters
+    # and the apply is within bf16 rounding of the f32 reference
+    ref = MixedBatchNorm(use_running_average=False, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)
+    yr, _ = ref.apply(v, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr), atol=0.05)

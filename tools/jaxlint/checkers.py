@@ -1423,6 +1423,92 @@ class SpanSyncChecker(Checker):
         return None
 
 
+_F32_LITERALS = {"jnp.float32", "np.float32", "numpy.float32",
+                 "jax.numpy.float32"}
+_ARRAY_CREATORS = {"zeros", "ones", "full", "empty", "array", "asarray",
+                   "arange", "zeros_like", "ones_like", "full_like",
+                   "linspace"}
+
+
+def _is_f32_literal(node) -> bool:
+    """``jnp.float32`` / ``np.float32`` / the string ``"float32"`` —
+    the raw-literal forms that bypass the policy object (a dtype read
+    off ``self.dtype`` / ``promote_types(...)`` is policy-derived and
+    passes)."""
+    if isinstance(node, ast.Constant):
+        return node.value == "float32"
+    name = dotted_name(node)
+    return name in _F32_LITERALS
+
+
+@register_checker
+class PrecisionPolicyChecker(Checker):
+    """Raw f32 introduced inside model ``__call__``/loss bodies: the
+    regression path by which the ISSUE 15 HBM diet silently erodes.
+    One ``x.astype(jnp.float32)`` (or an f32-literal array creation)
+    in a hot body re-materializes a full-size f32 activation on every
+    step — invisible to tests (numerics only improve) and to the
+    cost-analysis ledger on backends that float-normalize anyway.
+
+    The numerics policy lives in ``core/precision.py`` and the module
+    ``dtype`` convention: compute-dtype reads come off ``self.dtype``,
+    precision FLOORS off ``jnp.promote_types(d, jnp.float32)``, f32
+    statistics inside ``layers.MixedBatchNorm``. Those idioms pass (the
+    dtype is policy-derived, not a literal); raw literals are flagged
+    and must either adopt the idiom or record a reasoned baseline
+    (deliberate f32 reduce floors, e.g. loss accumulation). Which
+    function names count as hot bodies is the ``precision_funcs``
+    knob."""
+
+    code = "JX123"
+    name = "policy-bypass-f32"
+    description = ("raw jnp.float32 cast / f32-literal array creation "
+                   "inside a model __call__/loss body bypassing the "
+                   "numerics policy (core/precision.py)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        if path_matches_dir(mod.relpath, mod.cfg.data_dirs):
+            return  # host pipelines: f32 there is JX114's (wire) beat
+        patterns = mod.cfg.precision_funcs
+        for info in mod.functions:
+            if not any(fnmatch.fnmatch(info.node.name, p)
+                       for p in patterns):
+                continue
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" \
+                        and node.args \
+                        and _is_f32_literal(node.args[0]):
+                    yield mod.finding(
+                        node, self.code,
+                        "raw '.astype(float32)' inside "
+                        f"'{info.node.name}' bypasses the numerics "
+                        "policy — use the module's compute dtype "
+                        "(self.dtype) or a promote_types precision "
+                        "floor, or record a reasoned baseline for a "
+                        "deliberate f32 reduction")
+                    continue
+                name = call_name(node)
+                if last_attr(name) not in _ARRAY_CREATORS:
+                    continue
+                dtype_args = [kw.value for kw in node.keywords
+                              if kw.arg == "dtype"]
+                # creators take dtype as the 2nd positional too
+                if len(node.args) >= 2:
+                    dtype_args.append(node.args[1])
+                if any(_is_f32_literal(a) for a in dtype_args):
+                    yield mod.finding(
+                        node, self.code,
+                        f"'{name}' creates an f32-literal array inside "
+                        f"'{info.node.name}' — full-size f32 "
+                        "intermediates are the diet's regression "
+                        "path; derive the dtype from the policy "
+                        "(self.dtype / promote_types) or baseline the "
+                        "deliberate f32 floor with a reason")
+
+
 # concurrency tier (JX118-JX122, ISSUE 14): importing for registration
 # side effects keeps every "import checkers" site (run_paths, the CLI)
 # seeing the full checker set

@@ -296,6 +296,15 @@ class LintConfig:
         "*sync_global_devices*", "*await_all_arrived*",
         "*blocking_key_value_get*",
     ])
+    # Function-name patterns treated as numerics-policy hot bodies
+    # (JX123): a raw jnp.float32 cast / f32-literal array creation
+    # inside one bypasses the mixed-precision policy
+    # (core/precision.py) — the regression path the HBM diet erodes
+    # by. Policy-derived dtypes (self.dtype, promote_types floors)
+    # pass; deliberate f32 reduce floors get reasoned baselines.
+    precision_funcs: list[str] = field(default_factory=lambda: [
+        "__call__", "loss_fn", "*_loss_fn", "*_loss",
+    ])
     # Function-name patterns treated as sentinel-consuming step loops
     # (JX116): a per-step float()/np.asarray()/device_get of the
     # in-graph sentinel outputs (the `sent_*` naming contract of
@@ -388,6 +397,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
         "timed_funcs", "loop_sleep_funcs", "wire_funcs",
         "cluster_funcs", "sentinel_funcs", "span_funcs",
+        "precision_funcs",
         "lock_name_patterns", "lock_blocking_calls", "collective_calls",
         "fork_unsafe_imports", "signal_safe_calls", "disable",
     ):
@@ -432,7 +442,13 @@ class DonationWaiver:
 class HbmBaseline:
     """Recorded ``hbm_gb_per_step`` for one (model, platform, mesh,
     batch) lowering — the regression ledger the ±tolerance gate compares
-    against, so the 76 GB class of numbers can only go down."""
+    against, so the 76 GB class of numbers can only go down.
+
+    ``wire_gb_per_step`` (optional, ISSUE 15) is the backend-neutral
+    twin: logical traced-step bytes at the avals' own dtypes
+    (ircheck.jaxpr_wire_bytes) — the number the bf16 diet provably
+    moves even where a backend's float normalization blinds cost
+    analysis to dtype (this box's cpu backend does exactly that)."""
 
     model: str
     platform: str  # jax backend the number was recorded on (cpu/tpu/...)
@@ -440,6 +456,19 @@ class HbmBaseline:
     hbm_gb_per_step: float
     mesh: str = "1x1"
     note: str = ""
+    wire_gb_per_step: float | None = None
+
+
+@dataclass
+class DietTarget:
+    """A declared mixed-precision diet floor: the case's bf16-policy
+    trace must show at least ``min_reduction`` lower wire bytes than
+    its f32 twin (``ircheck --diet``). The acceptance numbers of
+    ISSUE 15 live here instead of in prose."""
+
+    model: str
+    min_reduction: float
+    reason: str = ""
 
 
 @dataclass
@@ -471,9 +500,12 @@ class IRCheckConfig:
     fast_models: list[str] = field(default_factory=lambda: [
         "lenet5", "lenet5_tf", "dcgan",
     ])
+    # registry-median floor for the --diet sweep (full runs only)
+    diet_median_min: float = 0.25
     donation: list[DonationWaiver] = field(default_factory=list)
     hbm: list[HbmBaseline] = field(default_factory=list)
     dtype: list[DtypeWaiver] = field(default_factory=list)
+    diet: list[DietTarget] = field(default_factory=list)
 
     def hbm_baseline(self, model: str, platform: str, mesh: str,
                      batch: int) -> HbmBaseline | None:
@@ -495,6 +527,12 @@ class IRCheckConfig:
                 return w
         return None
 
+    def diet_target(self, model: str) -> DietTarget | None:
+        for t in self.diet:
+            if t.model == model:
+                return t
+        return None
+
 
 def load_ircheck_config(path: str | Path | None) -> IRCheckConfig:
     """Build an IRCheckConfig from ``jaxlint.toml`` (defaults if
@@ -508,7 +546,8 @@ def load_ircheck_config(path: str | Path | None) -> IRCheckConfig:
         return cfg
     data = loads_toml(path.read_text())
     table = data.get("ircheck", {})
-    for name in ("donation_min_fraction", "hbm_tolerance"):
+    for name in ("donation_min_fraction", "hbm_tolerance",
+                 "diet_median_min"):
         if name in table:
             setattr(cfg, name, float(table[name]))
     if "fast_models" in table:
@@ -530,12 +569,24 @@ def load_ircheck_config(path: str | Path | None) -> IRCheckConfig:
             if req not in entry:
                 raise TomlError(
                     f"ircheck.hbm baseline needs {req!r}: {entry!r}")
+        wire = entry.get("wire_gb_per_step")
         cfg.hbm.append(HbmBaseline(
             model=entry["model"], platform=entry["platform"],
             batch=int(entry["batch"]),
             hbm_gb_per_step=float(entry["hbm_gb_per_step"]),
             mesh=str(entry.get("mesh", "1x1")),
             note=str(entry.get("note", "")),
+            wire_gb_per_step=float(wire) if wire is not None else None,
+        ))
+    for entry in table.get("diet", []):
+        for req in ("model", "min_reduction"):
+            if req not in entry:
+                raise TomlError(
+                    f"ircheck.diet entry needs {req!r}: {entry!r}")
+        cfg.diet.append(DietTarget(
+            model=entry["model"],
+            min_reduction=float(entry["min_reduction"]),
+            reason=str(entry.get("reason", "")),
         ))
     for entry in table.get("dtype", []):
         if "model" not in entry:

@@ -232,6 +232,74 @@ def f32_surface(jaxpr, min_bytes: int = 1 << 20) -> dict:
         key=lambda kv: -kv[1]["count"] * kv[1]["bytes_each"]))}
 
 
+def jaxpr_wire_bytes(jaxpr) -> int:
+    """Logical HBM bytes of one traced step: operand + output bytes
+    summed over every equation (sub-jaxprs recursed, the wrapping call
+    not double-charged), with ``convert_element_type`` charged ZERO and
+    read THROUGH to the source aval — XLA fuses pure dtype converts
+    into producers/consumers, so charging them (or their outputs at the
+    converted dtype) would hide exactly what the bf16 diet changes.
+
+    This is the backend-neutral twin of the XLA cost-analysis ledger:
+    on this dev box the CPU backend float-normalizes every convolution
+    to f32 (measured: 98/98 resnet50 convs, bf16 13.84 GB vs f32
+    13.63 GB — the dtype diet is invisible to cpu cost analysis), so
+    the wire ledger is what proves the diet on the compiled artifact
+    here; on-chip rows re-record the cost-analysis number natively.
+    Loop bodies (scan/while) are charged once per trace — a relative
+    ledger, not a wall-clock model."""
+    import math
+
+    def aval_bytes(aval):
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None:
+            return 0
+        try:
+            itemsize = dtype.itemsize
+        except AttributeError:
+            return 0
+        return (math.prod(shape) if shape else 1) * itemsize
+
+    def visit(j, total=0):
+        # var id -> source aval through convert chains
+        src: dict[int, object] = {}
+
+        def source(v):
+            aval = getattr(v, "aval", None)
+            return src.get(id(v), aval)
+
+        for eqn in j.eqns:
+            subs = []
+            for p in eqn.params.values():
+                seq = p if isinstance(p, (tuple, list)) else (p,)
+                for e in seq:
+                    sj = getattr(e, "jaxpr",
+                                 e if hasattr(e, "eqns") else None)
+                    if sj is not None:
+                        subs.append(sj)
+            if eqn.primitive.name == "convert_element_type":
+                a = source(eqn.invars[0])
+                if a is not None:
+                    src[id(eqn.outvars[0])] = a
+                continue
+            if subs:
+                for sj in subs:
+                    total = visit(sj, total)
+                continue
+            for v in eqn.invars:
+                a = source(v)
+                if a is not None:
+                    total += aval_bytes(a)
+            for v in eqn.outvars:
+                a = getattr(v, "aval", None)
+                if a is not None:
+                    total += aval_bytes(a)
+        return total
+
+    return visit(jaxpr)
+
+
 def pixel_f32_inputs(batch_leaves: list[tuple[str, tuple, str]]
                      ) -> list[str]:
     """Pixel-looking f32/f64 tensors among (path, shape, dtype) input
@@ -261,20 +329,22 @@ class IRCase:
 
 
 def _cls_build(cfg_name: str, *, registry_name: str | None = None,
-               f32_wire: bool = False, model_dtype: str = "bfloat16"):
-    """Classification family: the shipped config's geometry, optimizer
-    and model_kwargs (``registry_name`` lowers a converter-parity
-    variant under the base config); uint8 wire + on-device
-    normalization unless the feed has no uint8 source (mnist/synthetic
-    → ``f32_wire``)."""
+               f32_wire: bool = False):
+    """Classification family: the shipped config's geometry, optimizer,
+    model_kwargs AND numerics policy — the config's explicit
+    ``precision`` declaration decides the model dtype and loss-scale
+    state, so the gate lowers the program training actually runs
+    (``registry_name`` lowers a converter-parity variant under the base
+    config); uint8 wire + on-device normalization unless the feed has
+    no uint8 source (mnist/synthetic → ``f32_wire``)."""
 
-    def build(batch: int):
+    def build(batch: int, precision: str | None = None):
         from functools import partial
 
         import jax
-        import jax.numpy as jnp
         import numpy as np
 
+        from deepvision_tpu.core.precision import get_policy
         from deepvision_tpu.models import get_model
         from deepvision_tpu.train.configs import get_config
         from deepvision_tpu.train.optimizers import make_optimizer
@@ -282,19 +352,20 @@ def _cls_build(cfg_name: str, *, registry_name: str | None = None,
         from deepvision_tpu.train.steps import classification_train_step
 
         cfg = get_config(cfg_name)
+        policy = get_policy(precision or cfg["precision"])
         size, ch = cfg["input_size"], cfg["channels"]
         kwargs = dict(cfg.get("model_kwargs", {}))
         if registry_name is not None:
             kwargs = {}  # variants don't take the base's model_kwargs
         model = get_model(registry_name or cfg_name,
                           num_classes=cfg["num_classes"],
-                          dtype=getattr(jnp, model_dtype), **kwargs)
+                          dtype=policy.compute_dtype, **kwargs)
         tx, _ = make_optimizer(cfg, steps_per_epoch=100)
         kind = "torch" if cfg.get("augment") == "pt" else "imagenet"
         wire = np.float32 if f32_wire else np.uint8
         SDS = jax.ShapeDtypeStruct
         state = jax.eval_shape(
-            lambda s: create_train_state(model, tx, s),
+            lambda s: create_train_state(model, tx, s, policy=policy),
             SDS((1, size, size, ch), wire))
         batch_sds = {"image": SDS((batch, size, size, ch), wire),
                      "label": SDS((batch,), np.int32)}
@@ -306,18 +377,22 @@ def _cls_build(cfg_name: str, *, registry_name: str | None = None,
 
 def _det_build(model_name: str, size: int, num_classes: int,
                step_attr: str, opt: str):
-    def build(batch: int):
+    def build(batch: int, precision: str | None = None):
         import jax
-        import jax.numpy as jnp
         import numpy as np
         import optax
 
         import deepvision_tpu.train.steps as S
+        from deepvision_tpu.core.precision import get_policy
         from deepvision_tpu.models import get_model
+        from deepvision_tpu.train.configs import get_config
         from deepvision_tpu.train.state import create_train_state
 
+        cfg = get_config(model_name)
+        policy = get_policy(precision or cfg["precision"])
         model = get_model(model_name, num_classes=num_classes,
-                          dtype=jnp.bfloat16)
+                          dtype=policy.compute_dtype,
+                          **cfg.get("model_kwargs", {}))
         tx = optax.adam(1e-3) if opt == "adam" \
             else optax.sgd(1e-3, momentum=0.9)
         SDS = jax.ShapeDtypeStruct
@@ -325,7 +400,7 @@ def _det_build(model_name: str, size: int, num_classes: int,
         # normalizes on device — same {'image','boxes','label'} contract
         # as bench._zoo_case
         state = jax.eval_shape(
-            lambda s: create_train_state(model, tx, s),
+            lambda s: create_train_state(model, tx, s, policy=policy),
             SDS((1, size, size, 3), np.uint8))
         batch_sds = {
             "image": SDS((batch, size, size, 3), np.uint8),
@@ -338,24 +413,30 @@ def _det_build(model_name: str, size: int, num_classes: int,
 
 
 def _pose_build():
-    def build(batch: int):
+    def build(batch: int, precision: str | None = None):
         import jax
-        import jax.numpy as jnp
         import numpy as np
         import optax
 
         import deepvision_tpu.train.steps as S
+        from deepvision_tpu.core.precision import get_policy
         from deepvision_tpu.models import get_model
+        from deepvision_tpu.train.configs import get_config
         from deepvision_tpu.train.state import create_train_state
 
-        # f32 MODEL dtype: the r4 bf16-cripples-hourglass finding pins
-        # the config; the WIRE is still uint8 (pose reader as_uint8)
+        # the shipped config's policy: bf16_scaled since ISSUE 15 (f32
+        # residual carrier + MixedBatchNorm + dynamic loss scaling —
+        # the structural fix for the r4 bf16 finding) with "stack"
+        # remat; the WIRE is still uint8 (pose reader as_uint8)
+        cfg = get_config("hourglass104")
+        policy = get_policy(precision or cfg["precision"])
         model = get_model("hourglass104", num_heatmaps=16,
-                          dtype=jnp.float32)
+                          dtype=policy.compute_dtype,
+                          **cfg.get("model_kwargs", {}))
         tx = optax.rmsprop(2.5e-4)
         SDS = jax.ShapeDtypeStruct
         state = jax.eval_shape(
-            lambda s: create_train_state(model, tx, s),
+            lambda s: create_train_state(model, tx, s, policy=policy),
             SDS((1, 256, 256, 3), np.uint8))
         batch_sds = {
             "image": SDS((batch, 256, 256, 3), np.uint8),
@@ -369,23 +450,28 @@ def _pose_build():
 
 
 def _dcgan_build():
-    def build(batch: int):
+    def build(batch: int, precision: str | None = None):
         import jax
-        import jax.numpy as jnp
         import numpy as np
 
+        from deepvision_tpu.core.precision import get_policy
         from deepvision_tpu.models import get_model
+        from deepvision_tpu.train.configs import get_config
         from deepvision_tpu.train.gan import (
             create_dcgan_state,
             dcgan_train_step,
         )
 
+        policy = get_policy(precision
+                            or get_config("dcgan")["precision"])
         SDS = jax.ShapeDtypeStruct
         # f32 [-1,1] reals (no record pipeline for the mnist-class GAN);
         # simultaneous G+D update is the compiled program (bench parity)
         state = jax.eval_shape(lambda _: create_dcgan_state(
-            get_model("dcgan_generator", dtype=jnp.bfloat16),
-            get_model("dcgan_discriminator", dtype=jnp.bfloat16)),
+            get_model("dcgan_generator", dtype=policy.compute_dtype),
+            get_model("dcgan_discriminator",
+                      dtype=policy.compute_dtype),
+            policy=policy),
             0)
         batch_sds = {"image": SDS((batch, 28, 28, 1), np.float32)}
         return state, batch_sds, dcgan_train_step
@@ -394,21 +480,26 @@ def _dcgan_build():
 
 
 def _cyclegan_build():
-    def build(batch: int):
+    def build(batch: int, precision: str | None = None):
         import jax
-        import jax.numpy as jnp
         import numpy as np
 
+        from deepvision_tpu.core.precision import get_policy
         from deepvision_tpu.models import get_model
+        from deepvision_tpu.train.configs import get_config
         from deepvision_tpu.train.gan import (
             create_cyclegan_state,
             cyclegan_train_step,
         )
 
+        policy = get_policy(precision
+                            or get_config("cyclegan")["precision"])
         SDS = jax.ShapeDtypeStruct
         state = jax.eval_shape(lambda _: create_cyclegan_state(
-            get_model("cyclegan_generator", dtype=jnp.bfloat16),
-            get_model("cyclegan_discriminator", dtype=jnp.bfloat16)),
+            get_model("cyclegan_generator", dtype=policy.compute_dtype),
+            get_model("cyclegan_discriminator",
+                      dtype=policy.compute_dtype),
+            policy=policy),
             0)
         batch_sds = {"a": SDS((batch, 256, 256, 3), np.float32),
                      "b": SDS((batch, 256, 256, 3), np.float32)}
@@ -427,14 +518,14 @@ def make_cases() -> dict[str, IRCase]:
 
     def cls(case_name: str, cfg_name: str, batch: int, *,
             registry_name: str | None = None, f32_wire: bool = False,
-            model_dtype: str = "bfloat16", notes: str = ""):
+            notes: str = ""):
         cases[case_name] = IRCase(
             case_name, (registry_name or cfg_name,), batch,
             _cls_build(cfg_name, registry_name=registry_name,
-                       f32_wire=f32_wire, model_dtype=model_dtype),
+                       f32_wire=f32_wire),
             notes)
 
-    cls("lenet5", "lenet5", 64, f32_wire=True, model_dtype="float32",
+    cls("lenet5", "lenet5", 64, f32_wire=True,
         notes="mnist/synthetic feed ships f32 1-channel")
     cls("alexnet1", "alexnet1", 8)
     cls("alexnet2", "alexnet2", 8)
@@ -456,7 +547,7 @@ def make_cases() -> dict[str, IRCase]:
                           ("inception1_ref", "inception1")):
         f32 = base == "lenet5"
         cls(variant, base, 64 if f32 else 8, registry_name=variant,
-            f32_wire=f32, model_dtype="float32" if f32 else "bfloat16",
+            f32_wire=f32,
             notes=f"converter-parity variant of {base}")
     cases["yolov3"] = IRCase(
         "yolov3", ("yolov3",), 2,
@@ -466,7 +557,7 @@ def make_cases() -> dict[str, IRCase]:
         _det_build("centernet", 256, 80, "centernet_train_step", "adam"))
     cases["hourglass104"] = IRCase(
         "hourglass104", ("hourglass104",), 2, _pose_build(),
-        "f32 model dtype pinned (r4 bf16-cripples-hourglass)")
+        "bf16_scaled + f32 carrier + stack remat (ISSUE 15 diet)")
     cases["dcgan"] = IRCase(
         "dcgan", ("dcgan_generator", "dcgan_discriminator"), 64,
         _dcgan_build(), "simultaneous G+D update, f32 [-1,1] reals")
@@ -485,7 +576,7 @@ def make_cases() -> dict[str, IRCase]:
 
 def check_case(case: IRCase, ircfg: IRCheckConfig, *,
                mesh_shape: tuple[int, int] = (1, 1),
-               bf16_ready: bool = False) -> dict:
+               bf16_ready: bool = False, diet: bool = False) -> dict:
     """Lower + compile one case and evaluate every contract; returns a
     report dict (``ok``/``failures``/measurements). Never raises — a
     broken build is itself a gate failure."""
@@ -528,6 +619,33 @@ def check_case(case: IRCase, ircfg: IRCheckConfig, *,
         # (c) recompile stability across two bucket sizes
         j1 = jax.make_jaxpr(step_fn)(state, batch1, key)
         j2 = jax.make_jaxpr(step_fn)(state, batch2, key)
+
+        # (e2) backend-neutral wire ledger: logical HBM bytes of the
+        # traced step at the avals' own dtypes (convert-fused) — the
+        # number the bf16 diet provably moves on EVERY backend (the
+        # cpu backend's float normalization blinds cost analysis to
+        # dtype; see jaxpr_wire_bytes)
+        wire_gb = round(jaxpr_wire_bytes(j1.jaxpr) / 1e9, 3)
+        rep["wire_gb_per_step"] = wire_gb
+
+        if diet:
+            # the diet twin: the SAME case traced under the f32 policy;
+            # the wire-byte ratio is the measured mixed-precision diet.
+            # Builders without a precision override (synthetic test
+            # cases) twin with themselves — an honest zero.
+            import inspect
+
+            takes_precision = "precision" in inspect.signature(
+                case.build).parameters
+            state32, batch32, step32 = (
+                case.build(b1, precision="f32") if takes_precision
+                else case.build(b1))
+            j32 = jax.make_jaxpr(step32)(state32, batch32, key)
+            wire32 = round(jaxpr_wire_bytes(j32.jaxpr) / 1e9, 3)
+            rep["wire_f32_gb_per_step"] = wire32
+            rep["diet_reduction"] = round(
+                1.0 - wire_gb / wire32, 4) if wire32 > 0 else 0.0
+
         diffs = compare_jaxprs(j1.jaxpr, j2.jaxpr, b1, b2)
         rep["stability_diffs"] = diffs[:8]
         if diffs:
@@ -662,14 +780,14 @@ def check_case(case: IRCase, ircfg: IRCheckConfig, *,
         # miraculous improvement and disarm the gate, and recording it
         # would poison the ledger with 0.0 rows.
         gb = round(hbm_gb_per_step(compiled), 3)
+        base = ircfg.hbm_baseline(case.name, rep["platform"],
+                                  mesh_str, case.batch)
         if gb <= 0.0:
             rep["notes"].append(
                 "XLA cost analysis unavailable on this build — HBM "
                 "ledger not evaluated (and nothing recorded)")
         else:
             rep["hbm_gb_per_step"] = gb
-            base = ircfg.hbm_baseline(case.name, rep["platform"],
-                                      mesh_str, case.batch)
             if base is None:
                 rep["notes"].append(
                     "no hbm baseline for this (platform, mesh, batch) — "
@@ -689,6 +807,42 @@ def check_case(case: IRCase, ircfg: IRCheckConfig, *,
                     rep["notes"].append(
                         f"hbm improved {base.hbm_gb_per_step} -> {gb}; "
                         "re-record the baseline to lock the gain in")
+        # the wire ledger gates with the same band (wire baselines are
+        # optional fields on the same [[ircheck.hbm]] rows)
+        if base is not None and base.wire_gb_per_step is not None:
+            hi = base.wire_gb_per_step * (1 + ircfg.hbm_tolerance)
+            lo = base.wire_gb_per_step * (1 - ircfg.hbm_tolerance)
+            if wire_gb > hi:
+                rep["failures"].append(
+                    f"wire_gb_per_step {wire_gb} exceeds baseline "
+                    f"{base.wire_gb_per_step} by more than "
+                    f"{ircfg.hbm_tolerance:.0%} — the diet's "
+                    "dtype-faithful ledger only ratchets DOWN")
+            elif wire_gb < lo:
+                rep["notes"].append(
+                    f"wire bytes improved {base.wire_gb_per_step} -> "
+                    f"{wire_gb}; re-record to lock the gain in")
+        elif base is not None:
+            rep["notes"].append(
+                "hbm baseline has no wire_gb_per_step yet — re-record "
+                "to arm the dtype-faithful gate")
+
+        # (f) the diet assertion ([[ircheck.diet]]): the measured
+        # bf16-vs-f32 wire reduction must clear the model's declared
+        # floor — the "≥40% for the deep models" acceptance, enforced
+        # on the traced artifact, not claimed
+        if diet and rep.get("diet_reduction") is not None:
+            target = ircfg.diet_target(case.name) or next(
+                (ircfg.diet_target(m) for m in case.models
+                 if ircfg.diet_target(m) is not None), None)
+            if target is not None \
+                    and rep["diet_reduction"] < target.min_reduction:
+                rep["failures"].append(
+                    f"mixed-precision diet {rep['diet_reduction']:.1%} "
+                    f"below the declared floor "
+                    f"{target.min_reduction:.0%} for {target.model} "
+                    f"(wire {rep['wire_f32_gb_per_step']} GB f32 -> "
+                    f"{rep['wire_gb_per_step']} GB policy)")
 
         if bf16_ready:
             rep["bf16_ready"] = f32_surface(j1.jaxpr)
@@ -703,7 +857,8 @@ def check_case(case: IRCase, ircfg: IRCheckConfig, *,
 
 def record_toml(rep: dict) -> str:
     """A ready-to-paste ``[[ircheck.hbm]]`` baseline block for one
-    case report."""
+    case report (wire ledger row included when measured)."""
+    wire = rep.get("wire_gb_per_step")
     return (
         "[[ircheck.hbm]]\n"
         f'model = "{rep["case"]}"\n'
@@ -711,13 +866,14 @@ def record_toml(rep: dict) -> str:
         f'mesh = "{rep["mesh"]}"\n'
         f"batch = {rep['batch']}\n"
         f"hbm_gb_per_step = {rep['hbm_gb_per_step']}\n"
+        + (f"wire_gb_per_step = {wire}\n" if wire is not None else "")
     )
 
 
 def run(names: list[str] | None = None, *, config: str = "jaxlint.toml",
         fast: bool = False, mesh: tuple[int, int] = (1, 1),
         bf16_ready: bool = False, record: bool = False,
-        verbose: bool = False) -> int:
+        diet: bool = False, verbose: bool = False) -> int:
     ircfg = load_ircheck_config(config)
     cases = make_cases()
     if names:
@@ -748,16 +904,22 @@ def run(names: list[str] | None = None, *, config: str = "jaxlint.toml",
     crashed_models: set[str] = set()
     to_record: list[str] = []
     models_covered: set[str] = set()
+    diet_cuts: list[float] = []
     for case in selected:
         rep = check_case(case, ircfg, mesh_shape=mesh,
-                         bf16_ready=bf16_ready)
+                         bf16_ready=bf16_ready, diet=diet)
         models_covered.update(rep["models"])
         status = "ok  " if rep["ok"] else "FAIL"
         gb = rep.get("hbm_gb_per_step", "-")
+        wire = rep.get("wire_gb_per_step", "-")
         frac = rep.get("donated_fraction")
         frac_s = f"{frac:.3f}" if isinstance(frac, float) else "-"
+        cut = rep.get("diet_reduction")
+        cut_s = f" diet={cut:.1%}" if cut is not None else ""
+        if cut is not None:
+            diet_cuts.append(cut)
         print(f"{status} {case.name:16s} b{case.batch:<3d} "
-              f"donated={frac_s} hbm={gb}GB "
+              f"donated={frac_s} hbm={gb}GB wire={wire}GB{cut_s} "
               f"axes={','.join(rep.get('collective_axes', [])) or '-'}")
         for note in rep["notes"]:
             print(f"     note: {note}")
@@ -767,12 +929,17 @@ def run(names: list[str] | None = None, *, config: str = "jaxlint.toml",
             print(rep["trace"], file=sys.stderr)
         if bf16_ready and "bf16_ready" in rep:
             surf = rep["bf16_ready"]
-            print(f"     bf16-ready worklist: {surf['total_mb']} MB f32 "
-                  "intermediates")
+            print(f"     residual f32 surface: {surf['total_mb']} MB "
+                  "(post-diet this is the POLICY FLOOR — BN statistics "
+                  "accumulation, f32 heads/carriers, loss reductions; "
+                  "JX123 gates new raw-f32 out of hot bodies)")
             for shape, r in list(surf["shapes"].items())[:6]:
                 print(f"       x{r['count']:<4d} "
                       f"{r['bytes_each']/1e6:8.1f} MB each  {shape}")
-        if rep.get("hbm_unbaselined") and "hbm_gb_per_step" in rep:
+        if record and "hbm_gb_per_step" in rep:
+            # --record is the (re-)record flow: print a paste-ready
+            # block for every measured case, not only missing ones —
+            # the diet re-bases the whole ledger at once
             to_record.append(record_toml(rep))
         if "trace" in rep:  # crashed before the waiver checks ran
             crashed_models.update({case.name, *case.models})
@@ -798,6 +965,19 @@ def run(names: list[str] | None = None, *, config: str = "jaxlint.toml",
     if record and to_record:
         print("\n# paste into jaxlint.toml (recorded hbm baselines):")
         print("\n".join(to_record))
+    if diet and diet_cuts:
+        import statistics
+
+        med = statistics.median(diet_cuts)
+        print(f"diet: median mixed-precision wire reduction "
+              f"{med:.1%} over {len(diet_cuts)} cases "
+              f"(floor {ircfg.diet_median_min:.0%})")
+        if len(diet_cuts) >= len(cases) and med < ircfg.diet_median_min:
+            # the registry-median floor only judges FULL sweeps — a
+            # subset median would cry wolf (or pass) on a biased sample
+            print(f"FAIL: registry-median diet {med:.1%} below the "
+                  f"{ircfg.diet_median_min:.0%} floor", file=sys.stderr)
+            failures += 1
     n = len(selected)
     print(f"ircheck: {n - failures}/{n} cases pass "
           f"({len(models_covered)} registry models covered)")
@@ -824,8 +1004,14 @@ def main(argv: list[str] | None = None) -> int:
                         help="report the f32 activation surface per "
                              "model (ROADMAP item-2 worklist)")
     parser.add_argument("--record", action="store_true",
-                        help="print [[ircheck.hbm]] TOML for cases "
-                             "missing a baseline on this platform")
+                        help="print paste-ready [[ircheck.hbm]] TOML "
+                             "(hbm + wire rows) for every measured "
+                             "case — the (re-)record flow")
+    parser.add_argument("--diet", action="store_true",
+                        help="trace each case's f32 twin and assert "
+                             "the mixed-precision wire-byte reduction "
+                             "against [[ircheck.diet]] floors + the "
+                             "registry-median floor")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     try:
@@ -834,7 +1020,8 @@ def main(argv: list[str] | None = None) -> int:
         parser.error(f"--mesh expects N,M (got {args.mesh!r})")
     return run(args.names or None, config=args.config, fast=args.fast,
                mesh=(n, m), bf16_ready=args.bf16_ready,
-               record=args.record, verbose=args.verbose)
+               record=args.record, diet=args.diet,
+               verbose=args.verbose)
 
 
 if __name__ == "__main__":

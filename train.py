@@ -44,9 +44,15 @@ def parse_args():
                    help="override the pose configs' joint count (the "
                         "synthetic set is fully learnable at 3 joints — "
                         "one per color channel)")
-    p.add_argument("--precision", default=None, choices=["bf16", "f32"],
-                   help="compute dtype (default: the model config's "
-                        "'precision', else bf16)")
+    p.add_argument("--precision", default=None,
+                   choices=["bf16", "bf16_scaled", "f32"],
+                   help="numerics policy (core/precision.py): bf16 "
+                        "activations/gradients over f32 master weights, "
+                        "bf16_scaled adds dynamic loss scaling, f32 is "
+                        "the parity/fallback mode. Default: the model "
+                        "config's explicit 'precision' declaration — "
+                        "the config table is the source of truth, this "
+                        "flag the only override")
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. 'cpu' for smoke runs; "
                         "jax.config wins over the JAX_PLATFORMS env var, "
@@ -236,12 +242,16 @@ def main():
         cfg["num_heatmaps"] = args.num_joints
     if args.input_size:
         cfg["input_size"] = args.input_size
-    from deepvision_tpu.core.precision import get_precision
+    from deepvision_tpu.core.precision import get_policy
 
-    # get_precision validates config-sourced names (argparse choices only
-    # cover the CLI flag) and normalizes aliases like "bfloat16"
-    dtype = get_precision(
-        args.precision or cfg.get("precision", "bf16")).compute_dtype
+    # get_policy validates config-sourced names (argparse choices only
+    # cover the CLI flag) and normalizes aliases like "bfloat16".
+    # Resolution order: CLI override > the config's EXPLICIT declaration
+    # (every shipped entry carries one — train/configs.py is the source
+    # of truth, so the CLI docs and the table can no longer disagree).
+    policy = get_policy(args.precision or cfg["precision"])
+    cfg["precision"] = policy.name  # Trainer builds the same policy
+    dtype = policy.compute_dtype
     if args.use_raw is not None and not (
             args.data_dir and cfg["dataset"] == "imagenet"):
         raise SystemExit(
@@ -346,7 +356,7 @@ def main():
                 "--profile-steps/--profile-dir ride the Trainer step "
                 "counter; the GAN fit_gan path has no profiler hook "
                 f"yet (this run: {args.model!r}; --trace works)")
-        run_gan(args, cfg, dtype)
+        run_gan(args, cfg, policy)
         return
     if cfg["dataset"] == "pose":
         model = get_model(args.model, dtype=dtype,
@@ -695,9 +705,11 @@ def _maybe_publish(args, ckpt_dir: str):
     publish_to_gcs(target, args.output_bucket, args.output_dir)
 
 
-def run_gan(args, cfg, dtype):
+def run_gan(args, cfg, policy):
     """GAN path: two-network state + fit_gan loop (train/gan.py)."""
     import jax
+
+    dtype = policy.compute_dtype
 
     from deepvision_tpu.core import create_mesh
     from deepvision_tpu.data.mnist import synthetic_mnist
@@ -740,6 +752,7 @@ def run_gan(args, cfg, dtype):
             get_model("dcgan_discriminator", dtype=dtype),
             noise_dim=cfg["noise_dim"],
             lr=cfg["optimizer_params"]["lr"],
+            policy=policy,
         )
         step_fn = dcgan_train_step
         if args.label_smooth:
@@ -778,6 +791,7 @@ def run_gan(args, cfg, dtype):
             image_size=size,
             lr_schedule=lr,
             beta1=cfg["optimizer_params"]["beta1"],
+            policy=policy,
         )
         step_fn = cyclegan_train_step
         if args.device_aug:
