@@ -84,6 +84,16 @@ lint-threads:
 # step/model/optimizer/precision code moves.
 lint-ir:
 	$(PY) -m tools.jaxlint.ircheck --diet
+	$(PY) -m tools.jaxlint.shardcheck
+
+# SPMD sharding & collective-traffic gate, fast subset
+# (tools/jaxlint/shardcheck.py): comms-byte ledger vs the
+# [[shardcheck.comms]] ratchets, implicit-resharding detector,
+# partition-rule coverage audit, and the mesh-generalization check
+# (2x1 vs 2x2 collective structure must match) on the cheap cases.
+# The registry-wide sweep rides `make lint-ir` above.
+lint-comms:
+	$(PY) -m tools.jaxlint.shardcheck --fast
 
 # post-diet residual: the remaining f32 surface per model — by design
 # the policy floors only (BN statistics accumulation, f32 heads and
@@ -282,7 +292,7 @@ threadcheck-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke
+check: lint lint-comms serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -406,4 +416,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-threads lint-ir bf16-ready precision-smoke check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-threads lint-ir lint-comms bf16-ready precision-smoke check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal

@@ -161,6 +161,26 @@ def main() -> None:
     compiled = step.lower(state, device_batch, key).compile()
     flops_step = _flops_per_step(compiled)
 
+    # Collective-traffic ledger of the SAME executable the measured
+    # loop runs (shardcheck's HLO parser): per-participant interconnect
+    # bytes/step, attributed per opcode. Zero on a single-chip mesh by
+    # construction; on a real slice this is the number the
+    # [[shardcheck.comms]] ratchets track over time.
+    comms = {}
+    try:
+        from tools.hbm_budget import strip_layouts
+        from tools.jaxlint.shardcheck import parse_collective_bytes
+
+        colls = parse_collective_bytes(strip_layouts(compiled.as_text()))
+        comms = {
+            "coll_gb_per_step": round(
+                sum(r["bytes"] for r in colls.values()) / 1e9, 3),
+            "collectives": {op: r["count"]
+                            for op, r in sorted(colls.items())},
+        }
+    except Exception as e:  # ledger is best-effort in the bench
+        print(f"# comms ledger skipped: {e!r}", file=sys.stderr)
+
     for _ in range(WARMUP):
         key, sub = jax.random.split(key)
         state, metrics = compiled(state, device_batch, sub)
@@ -241,6 +261,7 @@ def main() -> None:
         ),
         "device_kind": kind,
         "s2d_stem": s2d,
+        **({"comms": comms} if comms else {}),
         **({"remat": remat} if remat else {}),
         **({"zoo": zoo} if zoo else {}),
         **fed,

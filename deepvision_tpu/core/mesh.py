@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_DATA = "data"
 AXIS_MODEL = "model"
+MESH_AXES = (AXIS_DATA, AXIS_MODEL)
 
 
 def create_mesh(
@@ -47,6 +48,14 @@ def create_mesh(
                          f"have {len(devices)}")
     grid = np.array(devices[:need]).reshape(n_data, n_model)
     return Mesh(grid, axis_names)
+
+
+def axis_size(mesh: Mesh, axis: str = AXIS_DATA) -> int:
+    """Extent of ``axis`` on ``mesh`` (1 when the axis is absent — a
+    degenerate 1-D mesh still divides by it cleanly). The one blessed
+    way to ask "how wide is data parallelism?": callers must not spell
+    the axis-name literal themselves (JX124)."""
+    return int(mesh.shape.get(axis, 1))
 
 
 def data_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:

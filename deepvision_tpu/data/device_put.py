@@ -15,6 +15,7 @@ local_batch × process_count = global batch — the reference's
 
 from __future__ import annotations
 
+from deepvision_tpu.core.mesh import axis_size
 from deepvision_tpu.core.mesh import shard_batch as shard_by_process
 
 # Compat re-export: the synchronous in-loop generator this module used
@@ -30,4 +31,4 @@ __all__ = ["shard_by_process", "global_batch_size", "device_prefetch"]
 def global_batch_size(mesh, per_device_batch: int) -> int:
     """per-device batch × all mesh data-axis devices (the reference's
     global-batch arithmetic, ref: YOLO/tensorflow/train.py:282)."""
-    return per_device_batch * mesh.shape["data"]
+    return per_device_batch * axis_size(mesh)

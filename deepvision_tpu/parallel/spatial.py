@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepvision_tpu.core.mesh import AXIS_DATA, AXIS_MODEL
+
 # shard_map graduated from jax.experimental.shard_map to jax.shard_map
 # across the jaxlib builds this repo runs on; resolve the newest name
 # first so both work (same env-skew class as tests/conftest.py probes)
@@ -91,7 +93,7 @@ def spatial_conv2d(
     kernel: jax.Array,
     mesh: Mesh,
     *,
-    spatial_axis: str = "model",
+    spatial_axis: str = AXIS_MODEL,
 ) -> jax.Array:
     """Stride-1 SAME conv with H sharded over ``mesh[spatial_axis]`` and
     batch over the ``data`` axis; halos move by explicit ring ppermute.
@@ -100,7 +102,7 @@ def spatial_conv2d(
     kernel (KH, KW, C, O) with odd KH; returns (B, H, W, O) with the
     same sharding as the input.
     """
-    spec = P("data", spatial_axis)
+    spec = P(AXIS_DATA, spatial_axis)
     shmap = shard_map(
         partial(_local_conv, axis_name=spatial_axis),
         mesh=mesh,

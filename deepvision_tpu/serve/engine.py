@@ -168,7 +168,9 @@ class InferenceEngine:
 
     # -- setup -----------------------------------------------------------
     def _check_ladders(self) -> None:
-        n_data = self._mesh.shape.get("data", 1)
+        from deepvision_tpu.core.mesh import axis_size
+
+        n_data = axis_size(self._mesh)
         for m in self._models.values():
             for b in self.ladder(m):
                 if b % n_data:

@@ -1044,7 +1044,14 @@ class Trainer:
                 fingerprint_fn=self._fingerprint_fn())
             source = f"epoch-{meta['epoch']} checkpoint"
         except FileNotFoundError:
-            self.state = jax.device_put(self._init_state)
+            # commit the reset to the MESH (replicated), not the default
+            # device: a bare device_put parks the whole state on device
+            # 0, which the donated jit then rejects or silently reshards
+            # every step on a multi-device mesh (JX125)
+            from deepvision_tpu.core.mesh import replicated_sharding
+
+            self.state = jax.device_put(
+                self._init_state, replicated_sharding(self.mesh))
             source = "initial state (no verifiable checkpoint yet)"
         self._reshard_state()
         if pol.lr_rewarm is not None and hasattr(

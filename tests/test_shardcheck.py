@@ -1,0 +1,356 @@
+"""SPMD/collective-traffic gate (tools/jaxlint/shardcheck.py): the
+pure helpers (HLO collective parser, mesh-string parse, cross-mesh
+structure comparator), the gate logic on cheap synthetic pjit cases
+(comms ratchet / implicit-reshard detector / rule-coverage audit all
+demonstrably fire AND waive), a known-bytes ledger pin on a toy
+sharded reduction, and live registry cases (lenet5 fast; the
+registry-wide two-mesh sweep is `make lint-ir`)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from tools.jaxlint.config import (
+    CommsBaseline,
+    PartitionRule,
+    ReshardWaiver,
+    ShardCheckConfig,
+    load_shardcheck_config,
+)
+from tools.jaxlint.ircheck import IRCase, make_cases
+from tools.jaxlint.shardcheck import (
+    check_case,
+    leaf_paths,
+    mesh_consistency,
+    parse_collective_bytes,
+    parse_mesh,
+    record_toml,
+)
+
+REPO_TOML = str(Path(__file__).resolve().parent.parent / "jaxlint.toml")
+
+# ---------------------------------------------------------- pure helpers
+
+
+def test_parse_collective_bytes_attributes_output_shapes():
+    hlo = """\
+ENTRY %main {
+  %ar = f32[256,4] all-reduce(f32[256,4] %p0), replica_groups={{0,1}}
+  %ag = bf16[8,128] all-gather(bf16[4,128] %p1), dimensions={0}
+  %fusion = f32[4] fusion(f32[4] %p2), kind=kLoop
+}
+"""
+    c = parse_collective_bytes(hlo)
+    assert c["all-reduce"] == {"count": 1, "bytes": 256 * 4 * 4}
+    assert c["all-gather"] == {"count": 1, "bytes": 8 * 128 * 2}
+    assert "fusion" not in c and len(c) == 2
+
+
+def test_parse_collective_bytes_variadic_and_async():
+    # a variadic all-reduce charges every tuple element; an async pair
+    # is ONE transfer (the -start carries the shape, -done is free)
+    hlo = """\
+  %v = (f32[8], f32[16]) all-reduce(f32[8] %a, f32[16] %b), to_apply=%add
+  %s = f32[32] all-gather-start(f32[16] %c), dimensions={0}
+  %d = f32[32] all-gather-done(f32[32] %s)
+"""
+    c = parse_collective_bytes(hlo)
+    assert c["all-reduce"] == {"count": 1, "bytes": (8 + 16) * 4}
+    assert c["all-gather"] == {"count": 1, "bytes": 32 * 4}
+
+
+def test_parse_collective_bytes_ignores_lhs_names():
+    # an instruction NAMED after an opcode must not be charged
+    hlo = "  %all-reduce.3 = f32[64] add(f32[64] %x, f32[64] %y)\n"
+    assert parse_collective_bytes(hlo) == {}
+
+
+def test_parse_mesh():
+    assert parse_mesh("2x1") == (2, 1)
+    assert parse_mesh("4X2") == (4, 2)
+    for bad in ("2", "axb", "0x2", "2x0", ""):
+        with pytest.raises(ValueError):
+            parse_mesh(bad)
+
+
+def test_mesh_consistency_comparator():
+    ok = [
+        {"mesh": "2x1", "collectives": {"all-reduce": {"count": 3,
+                                                       "bytes": 100}}},
+        {"mesh": "2x2", "collectives": {"all-reduce": {"count": 3,
+                                                       "bytes": 40}}},
+    ]
+    # per-device BYTES legitimately change with the mesh; COUNTS don't
+    assert mesh_consistency(ok) == []
+    bad = [ok[0], {"mesh": "2x2", "collectives": {
+        "all-reduce": {"count": 3, "bytes": 40},
+        "all-gather": {"count": 1, "bytes": 8}}}]
+    probs = mesh_consistency(bad)
+    assert len(probs) == 1 and "2x2" in probs[0]
+    # a single compiled mesh has nothing to compare
+    assert mesh_consistency([ok[0]]) == []
+    # a waived opcode may vary per grid (declared traffic is
+    # partitioner-chosen — yolo's scatter gathers, RNG permutes)
+    waived = [dict(bad[0], waived_ops=["all-gather"]), bad[1]]
+    assert mesh_consistency(waived) == []
+
+
+def test_shardcheck_config_lookup_and_validation(tmp_path):
+    p = tmp_path / "jaxlint.toml"
+    p.write_text("""
+[shardcheck]
+comms_tolerance = 0.1
+expected_collectives = ["all-reduce", "reduce-scatter"]
+
+[[shardcheck.rule]]
+pattern = "^params(/|$)"
+spec = "replicated"
+
+[[shardcheck.comms]]
+model = "toy"
+platform = "cpu"
+mesh = "2x1"
+batch = 8
+coll_gb_per_step = 0.5
+
+[[shardcheck.reshard]]
+model = "toy"
+op = "collective-*"
+reason = "halo exchange"
+""")
+    cfg = load_shardcheck_config(p)
+    assert cfg.comms_tolerance == 0.1
+    assert cfg.comms_baseline("toy", "cpu", "2x1", 8).coll_gb_per_step \
+        == 0.5
+    assert cfg.comms_baseline("toy", "cpu", "2x2", 8) is None
+    assert cfg.reshard_waiver("toy", "2x1", "collective-permute")
+    assert cfg.reshard_waiver("toy", "2x1", "all-to-all") is None
+    assert cfg.match_rule("params/c1/kernel").spec == "replicated"
+    assert cfg.match_rule("opt_state/0/mu") is None
+    # a reshard waiver without a reason is rejected like every ledger
+    p.write_text("""
+[[shardcheck.reshard]]
+model = "toy"
+op = "*"
+""")
+    with pytest.raises(Exception):
+        load_shardcheck_config(p)
+    # …and an unparseable rule regex fails loudly, not at match time
+    p.write_text("""
+[[shardcheck.rule]]
+pattern = "params/("
+spec = "replicated"
+""")
+    with pytest.raises(Exception):
+        load_shardcheck_config(p)
+
+
+# ------------------------------------------------- synthetic gate cases
+
+
+def _toy_case(reshard: bool = False) -> IRCase:
+    """A tiny real pjit train step: batch-sharded x, replicated params,
+    one gradient-free update. ``reshard`` adds per-example RNG — the
+    same non-partitionable-threefry mechanism that permutes key
+    counters across batch shards in the registry's dropout/GAN models."""
+
+    def build(batch: int, precision=None):
+        import jax
+        import jax.numpy as jnp
+
+        SDS = jax.ShapeDtypeStruct
+        state = {"params": SDS((4, 4), jnp.float32)}
+        batch_sds = {"x": SDS((batch, 4), jnp.float32)}
+
+        def step_fn(state, b, key):
+            x = b["x"]
+            if reshard:
+                keys = jax.random.split(key, x.shape[0])
+                x = x + jax.vmap(
+                    lambda k: jax.random.normal(k, (4,)))(keys)
+            loss = jnp.mean((x @ state["params"]) ** 2)
+            return ({"params": state["params"] - 0.01 * loss},
+                    {"loss": loss})
+
+        return state, batch_sds, step_fn
+
+    return IRCase(name="toy", models=("toy",), batch=8, build=build)
+
+
+_COVER_ALL = [PartitionRule(pattern=".*", spec="replicated")]
+
+
+def test_toy_sharded_sum_has_known_collective_bytes():
+    # the ledger's ground truth: summing f32[8,1024] over the sharded
+    # batch dim on a 2x1 mesh is ONE all-reduce of f32[1024] = 4096 B
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepvision_tpu.core import create_mesh
+    from tools.hbm_budget import strip_layouts
+
+    mesh = create_mesh(2, 1)
+    f = jax.jit(lambda x: x.sum(axis=0),
+                in_shardings=NamedSharding(mesh, P("data")),
+                out_shardings=NamedSharding(mesh, P()))
+    c = f.lower(jax.ShapeDtypeStruct((8, 1024), jnp.float32)).compile()
+    colls = parse_collective_bytes(strip_layouts(c.as_text()))
+    assert colls == {"all-reduce": {"count": 1, "bytes": 4096}}
+
+
+def test_clean_data_parallel_step_passes():
+    scfg = ShardCheckConfig(rules=list(_COVER_ALL))
+    rep = check_case(_toy_case(), scfg, mesh_shape=(2, 1))
+    assert rep["ok"], rep["failures"]
+    assert set(rep["collectives"]) == {"all-reduce"}
+    assert rep["unmatched_leaves"] == []
+    assert rep.get("comms_unbaselined")
+    # and the recorded block is paste-ready TOML
+    block = record_toml(rep)
+    assert block.startswith("[[shardcheck.comms]]")
+    assert 'mesh = "2x1"' in block
+
+
+def test_comms_ratchet_fails_above_and_nudges_below():
+    over = ShardCheckConfig(rules=list(_COVER_ALL), comms=[
+        CommsBaseline(model="toy", platform="cpu", batch=8,
+                      coll_gb_per_step=0.0, mesh="2x1")])
+    rep = check_case(_toy_case(), over, mesh_shape=(2, 1))
+    # the toy step's collectives round to 0.0 GB, matching exactly
+    assert rep["ok"], rep["failures"]
+    # an inflated baseline draws the improved-nudge note instead
+    under = ShardCheckConfig(rules=list(_COVER_ALL), comms=[
+        CommsBaseline(model="toy", platform="cpu", batch=8,
+                      coll_gb_per_step=5.0, mesh="2x1")])
+    rep = check_case(_toy_case(), under, mesh_shape=(2, 1))
+    assert rep["ok"] and any("re-record" in n for n in rep["notes"])
+    # and a regression (measured above baseline+tol) fails the gate
+    regress = ShardCheckConfig(rules=list(_COVER_ALL), comms=[
+        CommsBaseline(model="toy", platform="cpu", batch=8,
+                      coll_gb_per_step=-1.0, mesh="2x1")])
+    rep = check_case(_toy_case(), regress, mesh_shape=(2, 1))
+    assert not rep["ok"] and any("ratchet" in f for f in rep["failures"])
+
+
+def test_implicit_reshard_detector_fires_and_waives():
+    scfg = ShardCheckConfig(rules=list(_COVER_ALL))
+    rep = check_case(_toy_case(reshard=True), scfg, mesh_shape=(2, 1))
+    assert not rep["ok"]
+    assert any("implicit reshard" in f and "collective-permute" in f
+               for f in rep["failures"])
+    waived = ShardCheckConfig(rules=list(_COVER_ALL), reshard=[
+        ReshardWaiver(model="toy", op="collective-permute",
+                      reason="per-example RNG under non-partitionable "
+                             "threefry")])
+    rep = check_case(_toy_case(reshard=True), waived, mesh_shape=(2, 1))
+    assert rep["ok"], rep["failures"]
+    assert any("reshard waived" in n for n in rep["notes"])
+    assert waived.reshard[0].hits == 1
+
+
+def test_rule_coverage_audit_flags_unmatched_leaves():
+    scfg = ShardCheckConfig(rules=[
+        PartitionRule(pattern="^params/nothing", spec="replicated")])
+    rep = check_case(_toy_case(), scfg, mesh_shape=(2, 1))
+    assert not rep["ok"]
+    assert rep["unmatched_leaves"] == ["params"]
+    assert any("replicated-by-default" in f for f in rep["failures"])
+    # audit_rules=False is the not-first-mesh path: coverage is
+    # mesh-independent and must not double-report
+    rep = check_case(_toy_case(), scfg, mesh_shape=(2, 2),
+                     audit_rules=False)
+    assert "unmatched_leaves" not in rep
+
+
+def test_check_case_refuses_oversized_mesh_instead_of_clamping():
+    import jax
+
+    scfg = ShardCheckConfig(rules=list(_COVER_ALL))
+    too_big = (len(jax.devices()) + 1, 1)
+    rep = check_case(_toy_case(), scfg, mesh_shape=too_big)
+    assert not rep["ok"] and "collectives" not in rep
+    assert any("devices" in f for f in rep["failures"])
+
+
+def test_leaf_paths_format_matches_rule_table():
+    # the '/'-joined path grammar the [[shardcheck.rule]] regexes are
+    # written against: dict keys, sequence indices, attr names
+    tree = {"params": {"c1": {"kernel": 1}}, "opt_state": [{"mu": 2}]}
+    paths = dict(leaf_paths(tree))
+    assert paths == {"params/c1/kernel": 1, "opt_state/0/mu": 2}
+
+
+# ------------------------------------------------- shipped-ledger pins
+
+
+def test_repo_rules_cover_every_toy_trainstate_head():
+    # the shipped table must speak for every state head the registry
+    # uses (step/params/batch_stats/opt_state); a new head in a future
+    # TrainState must force a conscious rule, not silent replication
+    cfg = load_shardcheck_config(REPO_TOML)
+    assert cfg.rules, "shipped jaxlint.toml lost its rule table"
+    for head in ("step", "params/c1/kernel", "batch_stats/bn/mean",
+                 "opt_state/0/mu/c1/kernel"):
+        assert cfg.match_rule(head) is not None, head
+    # ZeRO-1 worklist: opt_state rows shard, param rows replicate
+    assert "largest" in cfg.match_rule("opt_state/0/mu/k").spec
+    assert cfg.match_rule("params/c1/kernel").spec == "replicated"
+
+
+def test_fast_models_and_meshes_are_valid():
+    cfg = load_shardcheck_config(REPO_TOML)
+    cases = make_cases()
+    for name in cfg.fast_models:
+        assert name in cases, f"[shardcheck] fast_models {name!r} " \
+            "matches no ircheck case"
+    shapes = [parse_mesh(s) for s in cfg.mesh_shapes]
+    assert len(shapes) >= 2, "mesh-generalization gate needs >=2 shapes"
+    for n, _m in shapes:
+        for case in cases.values():
+            assert case.batch % n == 0, \
+                f"{case.name} batch {case.batch} not divisible by " \
+                f"data axis {n}"
+
+
+# ------------------------------------------------------ live registry
+
+
+def test_shardcheck_lenet5_live_two_meshes():
+    cfg = load_shardcheck_config(REPO_TOML)
+    case = make_cases()["lenet5"]
+    reps = []
+    for i, mesh in enumerate([(2, 1), (2, 2)]):
+        rep = check_case(case, cfg, mesh_shape=mesh, audit_rules=(i == 0))
+        assert rep["ok"], (rep["mesh"], rep["failures"])
+        assert "all-reduce" in rep["collectives"]
+        reps.append(rep)
+    assert reps[0]["unmatched_leaves"] == []
+    assert mesh_consistency(reps) == []
+
+
+def test_shardcheck_dcgan_live_waives_rng_permutes():
+    # the registry's measured implicit-reshard case: per-example RNG
+    # under non-partitionable threefry permutes key counters across
+    # batch shards — declared in [[shardcheck.reshard]], not silent
+    cfg = load_shardcheck_config(REPO_TOML)
+    rep = check_case(make_cases()["dcgan"], cfg, mesh_shape=(2, 1))
+    assert rep["ok"], rep["failures"]
+    assert "collective-permute" in rep["collectives"]
+    assert any("reshard waived" in n for n in rep["notes"])
+
+
+def test_zero1_residency_reconciles_with_state_bytes():
+    from deepvision_tpu.core import create_mesh
+    from tools.jaxlint.shardcheck import zero1_residency
+
+    case = make_cases()["lenet5"]
+    state, _batch, _step = case.build(case.batch)
+    z = zero1_residency(state, create_mesh(2, 1))
+    assert z["n_data"] == 2
+    # residency after ZeRO-1 = unshardable + shardable/n_data, and the
+    # whole table is bounded by the state it describes
+    assert z["resid_gb"] <= z["opt_gb"] <= z["state_gb"]
+    assert z["shardable_gb"] <= z["opt_gb"]

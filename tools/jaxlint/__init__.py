@@ -4,23 +4,37 @@ The classes of bugs that silently destroy TPU step time — host↔device
 syncs inside jitted code, recompilation hazards, PRNG key reuse, missing
 buffer donation, dropped sharding constraints — are exactly the ones
 pytest does not catch (the program is *correct*, just slow or subtly
-non-reproducible). This package encodes those invariants as a TWO-LAYER
+non-reproducible). This package encodes those invariants as a FOUR-TIER
 analyzer every PR runs:
 
     python -m tools.jaxlint deepvision_tpu/          # interprocedural AST pass
     python -m tools.jaxlint.evalcheck                # whole-zoo abstract-eval gate
     python -m tools.jaxlint.ircheck [--fast]         # compiled-IR contract gate
+    python -m tools.jaxlint.shardcheck [--fast]      # SPMD/collective-traffic gate
 
-Layer 1 (core.py + checkers.py) is the AST pass, interprocedural since
+Tier 1 (core.py + checkers.py) is the AST pass, interprocedural since
 ISSUE 10: a per-run ProjectContext resolves calls across function and
 module boundaries, so hazards routed through imported helpers are
 caught without ``*_funcs`` name-pattern knobs (the knobs remain as
-seeds). Layer 2 (ircheck.py) lowers + compiles the REAL train step of
+seeds); ``--format sarif`` emits a SARIF 2.1.0 log and
+``--prune-baselines [--fix]`` burns paid-down debt out of the ledger.
+Tier 2 (ircheck.py) lowers + compiles the REAL train step of
 every registry model and verifies contracts on the jaxpr/optimized HLO:
 donation actually aliased (JX104 enforcement + ledger), no f64 / no f32
 pixels on the H2D boundary, jaxpr stability across bucket sizes,
-collective axes vs the mesh, and the per-model ``hbm_gb_per_step``
-regression ledger (±5%, jaxlint.toml).
+collective axes vs the mesh, and the per-model ``hbm_gb_per_step`` /
+``wire_gb_per_step`` regression ledgers (±5%, jaxlint.toml). Tier 3
+(concurrency.py + threadcheck.py) is the host-runtime lock/thread
+discipline — JX118–JX122 statically, plus the runtime lock sanitizer.
+Tier 4 (shardcheck.py) rides ircheck's harness at real multi-device
+CPU meshes: the per-(model, mesh, batch) collective-byte ledger
+(``[[shardcheck.comms]]``, ±5%), the implicit-resharding detector
+(unexpected collective opcodes need reasoned ``[[shardcheck.reshard]]``
+waivers), the partition-rule coverage audit (every state leaf of every
+registry model must match a ``[[shardcheck.rule]]`` row;
+``--zero1-ready`` prints the ZeRO-1 residency worklist), and the
+mesh-generalization gate (collective structure identical across mesh
+shapes).
 
 Checker codes (tools/jaxlint/checkers.py):
 
@@ -56,6 +70,29 @@ Checker codes (tools/jaxlint/checkers.py):
     JX117  `with span(...)` over a compiled-step call with no
            device_sync/block_until_ready before the span end (the
            JX112 async-dispatch lie recorded into the trace)
+    JX118  shared instance state touched by a thread-target method and
+           a public method with either side outside the instance lock
+    JX119  blocking call (HTTP/subprocess/file I/O/sleep/unbounded
+           get/join/wait, incl. transitively) under a held lock
+    JX120  lock-order cycle in the project-wide acquisition graph, or
+           any lock held across a cross-host collective/barrier
+    JX121  multiprocessing Pool/Process/Queue without an explicit
+           spawn context in a module that reaches jax/tf (fork after
+           runtime init inherits dead mutexes)
+    JX122  signal handler that locks/allocates/does non-atomic I/O
+           (self-deadlock when it interrupts its own critical section)
+    JX123  raw f32 cast / f32-literal array in a model/loss hot body
+           (the mixed-precision diet's erosion path)
+    JX124  hardcoded mesh axis-name literal ("data"/"model" in
+           PartitionSpec/Mesh ctors, collective axis args,
+           mesh.shape lookups, axis-parameter defaults) outside
+           core/mesh.py — spell AXIS_DATA/AXIS_MODEL/axis_size(mesh)
+    JX125  bare jax.device_put with no sharding on a multi-device
+           path (parks the tree on device 0; the donated jit rejects
+           or silently reshards it every step)
+    JX126  inline PartitionSpec(...) in model/step code — sharding
+           decisions belong in the [[shardcheck.rule]] table or
+           core/'s spec-building helpers
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

@@ -1509,6 +1509,204 @@ class PrecisionPolicyChecker(Checker):
                         "deliberate f32 floor with a reason")
 
 
+# ----------------------------------------------- SPMD tier (JX124-JX126)
+# Source-level companions of the compiled-IR SPMD gate
+# (tools/jaxlint/shardcheck.py): shardcheck proves properties of the
+# lowered program; these keep the SOURCE from growing the idioms that
+# make those proofs fragile (scattered axis names, un-sharded
+# transfers, inline PartitionSpecs outside the rules table).
+
+
+_SPEC_CTORS = {"PartitionSpec", "P"}
+_MESH_CTORS = {"Mesh", "make_mesh", "create_mesh"}
+# collectives whose first argument / axis kwarg names a mesh axis
+_AXIS_ARG_CALLS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pswapaxes", "axis_index", "axis_size", "psum_scatter",
+}
+_AXIS_KWARGS = {"axis_name", "axis_names", "axis", "spatial_axis",
+                "data_axis", "model_axis"}
+
+
+def _axis_literals_in(node: ast.AST, names: set[str]
+                      ) -> Iterator[ast.Constant]:
+    """String constants (tuples/lists included) whose value is a
+    declared mesh axis name."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and sub.value in names:
+            yield sub
+
+
+@register_checker
+class MeshAxisLiteralChecker(Checker):
+    """Hardcoded mesh axis names outside the mesh's definition site.
+    ``core/mesh.py`` owns ``AXIS_DATA``/``AXIS_MODEL``; a string
+    ``"data"`` baked into a PartitionSpec, a ``mesh.shape[...]`` lookup
+    or a collective's ``axis_name`` elsewhere means renaming or
+    reshaping the mesh (the exact move ROADMAP item 1 makes) is a
+    repo-wide grep instead of a one-file change — and shardcheck's
+    rules table can silently diverge from what the code spells. Only
+    sharding-shaped contexts are scanned, so ``"model"`` as a dict key
+    or log field stays legal."""
+
+    code = "JX124"
+    name = "hardcoded-mesh-axis"
+    description = ("mesh axis name spelled as a string literal outside "
+                   "core/mesh.py (use AXIS_DATA/AXIS_MODEL)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        cfg = mod.cfg
+        if any(fnmatch.fnmatch(mod.relpath, p)
+               for p in cfg.mesh_axis_home):
+            return
+        names = set(cfg.mesh_axis_names)
+        if not names:
+            return
+        seen: set[int] = set()
+
+        def hit(const: ast.Constant, ctx: str) -> Iterator[Finding]:
+            if id(const) in seen:
+                return
+            seen.add(id(const))
+            yield mod.finding(
+                const, self.code,
+                f"mesh axis name '{const.value}' hardcoded in {ctx} — "
+                "import AXIS_DATA/AXIS_MODEL from core.mesh so the "
+                "mesh stays a one-file change")
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                fn = last_attr(call_name(node))
+                if fn in _SPEC_CTORS | _MESH_CTORS:
+                    for arg in list(node.args) + [
+                            k.value for k in node.keywords]:
+                        for c in _axis_literals_in(arg, names):
+                            yield from hit(c, f"a {fn}(...) argument")
+                elif fn in _AXIS_ARG_CALLS:
+                    args = list(node.args[1:2]) + [
+                        k.value for k in node.keywords
+                        if k.arg in _AXIS_KWARGS]
+                    for arg in args:
+                        for c in _axis_literals_in(arg, names):
+                            yield from hit(c, f"the axis of {fn}(...)")
+                else:
+                    for k in node.keywords:
+                        if k.arg in _AXIS_KWARGS:
+                            for c in _axis_literals_in(k.value, names):
+                                yield from hit(
+                                    c, f"keyword {k.arg}= of {fn}(...)")
+                # mesh.shape.get("data", 1)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "get" \
+                        and isinstance(node.func.value, ast.Attribute) \
+                        and node.func.value.attr == "shape" \
+                        and node.args:
+                    for c in _axis_literals_in(node.args[0], names):
+                        yield from hit(c, "a mesh.shape lookup")
+            elif isinstance(node, ast.Subscript):
+                # mesh.shape["data"]
+                if isinstance(node.value, ast.Attribute) \
+                        and node.value.attr == "shape":
+                    for c in _axis_literals_in(node.slice, names):
+                        yield from hit(c, "a mesh.shape lookup")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                # def f(..., spatial_axis: str = "model")
+                a = node.args
+                pairs = list(zip(
+                    (a.posonlyargs + a.args)[::-1], a.defaults[::-1]))
+                pairs += [(kw, d) for kw, d in
+                          zip(a.kwonlyargs, a.kw_defaults)
+                          if d is not None]
+                for arg, default in pairs:
+                    if "axis" not in arg.arg:
+                        continue
+                    for c in _axis_literals_in(default, names):
+                        yield from hit(
+                            c, f"the default of parameter {arg.arg!r}")
+
+
+@register_checker
+class UnshardedTransferChecker(Checker):
+    """A bare single-argument ``jax.device_put(x)`` on a multi-device
+    code path: with no sharding/device operand the transfer lands fully
+    replicated on the default device — on a 2+-device mesh that
+    silently gathers a sharded array (one blocking cross-device copy
+    per step) or parks state off-mesh where the next compiled step
+    reshards it back (the implicit-transfer class shardcheck's detector
+    flags in the IR). Every transfer on a sharded path must name its
+    sharding, or go through ``core.mesh.shard_batch`` which applies
+    one. Which directories count as multi-device paths is the
+    ``multidevice_dirs`` knob."""
+
+    code = "JX125"
+    name = "unsharded-device-put"
+    description = ("single-argument device_put on a multi-device path "
+                   "(no sharding: replicates onto the default device)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        if not path_matches_dir(mod.relpath, mod.cfg.multidevice_dirs):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_attr(call_name(node)) != "device_put":
+                continue
+            if len(node.args) >= 2 or any(
+                    k.arg in ("device", "sharding", "dst_sharding")
+                    for k in node.keywords):
+                continue
+            yield mod.finding(
+                node, self.code,
+                "device_put without a sharding on a multi-device path "
+                "— the array replicates onto the default device; pass "
+                "the NamedSharding (or use shard_batch) so the "
+                "placement survives mesh growth")
+
+
+@register_checker
+class InlinePartitionSpecChecker(Checker):
+    """Literal ``PartitionSpec``/``P`` construction in model or step
+    code. Sharding decisions live in the declarative
+    ``[[shardcheck.rule]]`` table (jaxlint.toml) that shardcheck audits
+    for coverage and ROADMAP item 1's engine consumes; a spec built
+    inline in ``models/``/``train/`` is invisible to both — it can't be
+    coverage-checked, can't be retuned per mesh, and is exactly how a
+    hand-sharded layer drifts from the rest of the model. The sharding
+    plumbing itself (``core/``, ``parallel/``) is the legitimate
+    interpreter of specs and stays exempt."""
+
+    code = "JX126"
+    name = "inline-partition-spec"
+    description = ("literal PartitionSpec in model/step code instead "
+                   "of the [[shardcheck.rule]] table")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        if not path_matches_dir(mod.relpath,
+                                mod.cfg.partition_rule_dirs):
+            return
+        # only flag files that actually bind the constructor to a
+        # PartitionSpec import — a local helper named P() elsewhere in
+        # train/ is not a sharding spec
+        bound: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        bound.add(alias.asname or alias.name)
+        if not bound:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and last_attr(call_name(node)) in bound:
+                yield mod.finding(
+                    node, self.code,
+                    "PartitionSpec constructed inline in model/step "
+                    "code — declare the sharding as a "
+                    "[[shardcheck.rule]] row (regex path -> spec) so "
+                    "the coverage audit and the sharding engine see it")
+
+
 # concurrency tier (JX118-JX122, ISSUE 14): importing for registration
 # side effects keeps every "import checkers" site (run_paths, the CLI)
 # seeing the full checker set

@@ -366,6 +366,40 @@ class LintConfig:
     fork_unsafe_imports: list[str] = field(default_factory=lambda: [
         "jax", "tensorflow",
     ])
+    # -- SPMD tier source checkers (JX124-JX126) --
+    # Mesh axis names the repo declares (core/mesh.py AXIS_DATA /
+    # AXIS_MODEL). JX124 flags these as string LITERALS in sharding
+    # contexts (PartitionSpec/Mesh arguments, ``mesh.shape[...]``
+    # lookups, ``axis_name=`` keywords, collective axis arguments,
+    # ``*axis*`` parameter defaults) anywhere outside the axis-name
+    # home — a renamed/reshaped mesh must be a one-file change, and the
+    # shardcheck rules table keys on the canonical names.
+    mesh_axis_names: list[str] = field(default_factory=lambda: [
+        "data", "model",
+    ])
+    # Files allowed to SPELL the axis-name literals (fnmatch on the
+    # lint-root relpath): the single definition site.
+    mesh_axis_home: list[str] = field(default_factory=lambda: [
+        "deepvision_tpu/core/mesh.py",
+    ])
+    # Directories whose code runs against multi-device meshes (JX125):
+    # a bare single-argument ``jax.device_put(x)`` there silently
+    # gathers/replicates onto the default device — every transfer on a
+    # sharded path must say its sharding (or go through
+    # core.mesh.shard_batch, which applies one).
+    multidevice_dirs: list[str] = field(default_factory=lambda: [
+        "deepvision_tpu/parallel", "deepvision_tpu/serve",
+        "deepvision_tpu/train", "deepvision_tpu/core",
+        "deepvision_tpu/resilience",
+    ])
+    # Directories where literal ``PartitionSpec``/``P`` construction is
+    # banned (JX126): model and step code must get specs from the
+    # ``[[shardcheck.rule]]`` table (via core/step helpers), not bake
+    # them in — the rules table is what shardcheck audits for coverage
+    # and what ROADMAP item 1's sharding engine consumes.
+    partition_rule_dirs: list[str] = field(default_factory=lambda: [
+        "deepvision_tpu/models", "deepvision_tpu/train",
+    ])
     # Call names (matched against the FULL dotted name — a bare "dump"
     # would exempt json.dump/pickle.dump, exactly the non-atomic I/O
     # JX122 flags) VETTED for use inside signal handlers: the
@@ -399,7 +433,9 @@ def load_config(path: str | Path | None) -> LintConfig:
         "cluster_funcs", "sentinel_funcs", "span_funcs",
         "precision_funcs",
         "lock_name_patterns", "lock_blocking_calls", "collective_calls",
-        "fork_unsafe_imports", "signal_safe_calls", "disable",
+        "fork_unsafe_imports", "signal_safe_calls",
+        "mesh_axis_names", "mesh_axis_home", "multidevice_dirs",
+        "partition_rule_dirs", "disable",
     ):
         if name in table:
             setattr(cfg, name, list(table[name]))
@@ -597,5 +633,188 @@ def load_ircheck_config(path: str | Path | None) -> IRCheckConfig:
                 "'reason' — every f32-pixel exception must say why")
         cfg.dtype.append(DtypeWaiver(
             model=entry["model"], reason=entry["reason"],
+        ))
+    return cfg
+
+
+# -------------------------------------------------------- shardcheck config
+
+
+@dataclass
+class PartitionRule:
+    """One row of the declarative sharding rules table
+    (``[[shardcheck.rule]]``): a regex over '/'-joined state-leaf paths
+    (``params/Conv_0/kernel``, ``opt_state/0/mu/Dense_0/bias`` …) and
+    the PartitionSpec it prescribes. ``spec`` is a tiny DSL the ROADMAP
+    item-1 sharding engine will interpret:
+
+    - ``"replicated"`` — ``P()`` on every matched leaf
+    - ``"data"`` / ``"data,*"`` … — per-dim axis entries (``*`` = None)
+    - ``"largest(data)"`` — shard the LARGEST axis-divisible dim
+      (``core.step.weight_update_sharding``'s ZeRO-1 rule)
+
+    shardcheck's coverage audit asserts every leaf of every registry
+    model matches a rule (first match wins, like the baseline ledger);
+    ``largest(...)`` rules additionally mark the ZeRO-1 worklist the
+    ``--zero1-ready`` residency table quantifies."""
+
+    pattern: str
+    spec: str
+    reason: str = ""
+    hits: int = 0  # filled by shardcheck; stale rules are warned about
+
+    def matches(self, leaf_path: str) -> bool:
+        return re.search(self.pattern, leaf_path) is not None
+
+
+@dataclass
+class CommsBaseline:
+    """Recorded collective-traffic bytes for one (model, platform,
+    mesh, batch) compile: ``coll_gb_per_step`` sums the output bytes of
+    every collective instruction (all-reduce / all-gather /
+    reduce-scatter / all-to-all / collective-permute) in the optimized
+    SPMD module — per-participant bytes, the ratchet twin of the
+    ``[[ircheck.hbm]]`` rows for the interconnect."""
+
+    model: str
+    platform: str
+    batch: int
+    coll_gb_per_step: float
+    mesh: str = "2x1"
+    note: str = ""
+
+
+@dataclass
+class ReshardWaiver:
+    """A justified implicit-resharding exception: ``model``'s compiled
+    step at ``mesh`` is allowed collective opcode ``op`` (fnmatch)
+    beyond the expected data-parallel set. ``reason`` is mandatory —
+    a deliberate reshard (ZeRO-1's reduce-scatter + all-gather, spatial
+    halo exchange) is declared here; an accidental one is a bug."""
+
+    model: str
+    op: str
+    reason: str
+    mesh: str = "*"
+    hits: int = 0
+
+
+@dataclass
+class ShardCheckConfig:
+    """Knobs + ledgers for the SPMD/collective-traffic gate
+    (``tools/jaxlint/shardcheck.py``), loaded from the ``[shardcheck]``
+    table and the ``[[shardcheck.rule]]`` / ``[[shardcheck.comms]]`` /
+    ``[[shardcheck.reshard]]`` arrays of ``jaxlint.toml``."""
+
+    # comms ledger gate: fail when measured > baseline * (1 + tol);
+    # nudge to re-record when measured < baseline * (1 - tol)
+    comms_tolerance: float = 0.05
+    # case names cheap enough for the tier-1/`make lint-comms` subset
+    fast_models: list[str] = field(default_factory=lambda: [
+        "lenet5", "lenet5_tf", "dcgan",
+    ])
+    # mesh shapes ("NxM") every case is lowered at; >=2 shapes arm the
+    # mesh-generalization gate (collective structure must not depend on
+    # the grid extents). Chosen so the data axis divides every case's
+    # batch (min registry batch is 2).
+    mesh_shapes: list[str] = field(default_factory=lambda: [
+        "2x1", "2x2",
+    ])
+    # collective opcodes a pure data-parallel replicated-params step is
+    # EXPECTED to contain (fnmatch): gradient/metric all-reduce. Any
+    # other collective in the compiled module is an implicit reshard
+    # pjit inserted behind the program's back and needs a waiver.
+    expected_collectives: list[str] = field(default_factory=lambda: [
+        "all-reduce",
+    ])
+    rules: list[PartitionRule] = field(default_factory=list)
+    comms: list[CommsBaseline] = field(default_factory=list)
+    reshard: list[ReshardWaiver] = field(default_factory=list)
+
+    def comms_baseline(self, model: str, platform: str, mesh: str,
+                       batch: int) -> CommsBaseline | None:
+        for b in self.comms:
+            if (b.model, b.platform, b.mesh, b.batch) == \
+                    (model, platform, mesh, batch):
+                return b
+        return None
+
+    def reshard_waiver(self, model: str, mesh: str,
+                       op: str) -> ReshardWaiver | None:
+        for w in self.reshard:
+            if w.model == model and fnmatch.fnmatch(op, w.op) \
+                    and fnmatch.fnmatch(mesh, w.mesh):
+                return w
+        return None
+
+    def match_rule(self, leaf_path: str) -> PartitionRule | None:
+        for r in self.rules:
+            if r.matches(leaf_path):
+                return r
+        return None
+
+
+def load_shardcheck_config(path: str | Path | None) -> ShardCheckConfig:
+    """Build a ShardCheckConfig from ``jaxlint.toml`` (defaults if
+    absent). Reshard waivers without a ``reason`` and rules with an
+    unparseable regex are rejected — same contract as every other
+    ledger in this file."""
+    cfg = ShardCheckConfig()
+    if path is None:
+        return cfg
+    path = Path(path)
+    if not path.exists():
+        return cfg
+    data = loads_toml(path.read_text())
+    table = data.get("shardcheck", {})
+    if "comms_tolerance" in table:
+        cfg.comms_tolerance = float(table["comms_tolerance"])
+    if "fast_models" in table:
+        cfg.fast_models = [str(x) for x in table["fast_models"]]
+    if "mesh_shapes" in table:
+        cfg.mesh_shapes = [str(x) for x in table["mesh_shapes"]]
+    if "expected_collectives" in table:
+        cfg.expected_collectives = [
+            str(x) for x in table["expected_collectives"]]
+    for entry in table.get("rule", []):
+        for req in ("pattern", "spec"):
+            if req not in entry:
+                raise TomlError(
+                    f"shardcheck.rule entry needs {req!r}: {entry!r}")
+        try:
+            re.compile(str(entry["pattern"]))
+        except re.error as e:
+            raise TomlError(
+                f"shardcheck.rule pattern {entry['pattern']!r} is not a "
+                f"valid regex: {e}") from None
+        cfg.rules.append(PartitionRule(
+            pattern=str(entry["pattern"]), spec=str(entry["spec"]),
+            reason=str(entry.get("reason", "")),
+        ))
+    for entry in table.get("comms", []):
+        for req in ("model", "platform", "batch", "coll_gb_per_step"):
+            if req not in entry:
+                raise TomlError(
+                    f"shardcheck.comms baseline needs {req!r}: {entry!r}")
+        cfg.comms.append(CommsBaseline(
+            model=entry["model"], platform=entry["platform"],
+            batch=int(entry["batch"]),
+            coll_gb_per_step=float(entry["coll_gb_per_step"]),
+            mesh=str(entry.get("mesh", "2x1")),
+            note=str(entry.get("note", "")),
+        ))
+    for entry in table.get("reshard", []):
+        for req in ("model", "op"):
+            if req not in entry:
+                raise TomlError(
+                    f"shardcheck.reshard entry needs {req!r}: {entry!r}")
+        if not str(entry.get("reason", "")).strip():
+            raise TomlError(
+                f"shardcheck.reshard waiver for {entry['model']!r} "
+                f"{entry['op']!r} has no 'reason' — every deliberate "
+                "reshard must say why it is intended")
+        cfg.reshard.append(ReshardWaiver(
+            model=entry["model"], op=entry["op"],
+            reason=entry["reason"], mesh=str(entry.get("mesh", "*")),
         ))
     return cfg

@@ -49,7 +49,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterable, Iterator
 
-from tools.jaxlint.config import BaselineEntry, LintConfig, load_config
+from tools.jaxlint.config import (BaselineEntry, LintConfig, TomlError,
+                                  load_config, loads_toml)
 
 __all__ = [
     "Checker", "Finding", "LintConfig", "ModuleContext", "ProjectContext",
@@ -1070,6 +1071,119 @@ def _baseline_match(cfg: LintConfig, f: Finding,
     return None
 
 
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(result: LintResult) -> dict:
+    """Render a LintResult as a SARIF 2.1.0 log (the interchange format
+    code-scanning UIs ingest): one run, one rule per registered checker,
+    one result per finding. Engine errors (unparseable files) become
+    tool-execution notifications so they surface in the UI instead of
+    only on stderr."""
+    rules = [
+        {
+            "id": code,
+            "name": c.name,
+            "shortDescription": {"text": c.description or c.name},
+            "helpUri": "https://github.com/deepvision-tpu"
+                       "/blob/main/tools/jaxlint/__init__.py",
+        }
+        for code, c in sorted(CHECKERS.items())
+    ]
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for f in result.findings:
+        res = {
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                },
+            }],
+        }
+        if f.code in rule_index:
+            res["ruleIndex"] = rule_index[f.code]
+        results.append(res)
+    notifications = [
+        {"level": "error", "message": {"text": err}}
+        for err in result.errors
+    ]
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jaxlint",
+                "informationUri": "https://github.com/deepvision-tpu"
+                                  "/blob/main/tools/jaxlint/__init__.py",
+                "rules": rules,
+            }},
+            "results": results,
+            "invocations": [{
+                "executionSuccessful": not result.errors,
+                "toolExecutionNotifications": notifications,
+            }],
+        }],
+    }
+
+
+def prune_baselines(config_path: str | Path,
+                    stale: list[BaselineEntry], *,
+                    fix: bool = False) -> tuple[str, int]:
+    """Drop the ``[[baseline]]`` blocks for ``stale`` entries from the
+    config text, preserving every other byte (the loader's round-trip
+    twin is deliberately NOT used — comments and formatting are the
+    ledger's documentation). A block's contiguous leading comment
+    paragraph goes with it. Returns (new_text, removed_count); writes
+    the file only when ``fix``."""
+    text = Path(config_path).read_text()
+    lines = text.splitlines(keepends=True)
+    keys = {(b.path, b.code, b.match) for b in stale}
+    removed = 0
+    drop: set[int] = set()
+    i = 0
+    while i < len(lines):
+        if lines[i].strip() != "[[baseline]]":
+            i += 1
+            continue
+        j = i + 1
+        while j < len(lines) and not lines[j].lstrip().startswith("["):
+            j += 1
+        # trailing blank lines separate this block from the next header;
+        # they belong to whichever block is removed
+        end = j
+        while end > i + 1 and not lines[end - 1].strip():
+            end -= 1
+        try:
+            entry = loads_toml("".join(lines[i:end]))["baseline"][0]
+        except (TomlError, KeyError, IndexError):
+            i = j
+            continue
+        key = (entry.get("path", ""), entry.get("code", ""),
+               entry.get("match", ""))
+        if key in keys:
+            removed += 1
+            start = i
+            # the block's own comment paragraph (contiguous comment
+            # lines directly above) documents only this entry
+            while start > 0 and lines[start - 1].lstrip().startswith("#"):
+                start -= 1
+            drop.update(range(start, j))
+            # absorb ONE of the now-doubled blank separators
+            if start > 0 and not lines[start - 1].strip() and j < len(lines):
+                drop.add(start - 1)
+        i = j
+    new_text = "".join(l for k, l in enumerate(lines) if k not in drop)
+    if fix and removed:
+        Path(config_path).write_text(new_text)
+    return new_text, removed
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -1088,7 +1202,23 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--list-checkers", action="store_true")
     parser.add_argument("--statistics", action="store_true",
                         help="print per-code counts and suppression totals")
+    parser.add_argument("--format", choices=["text", "sarif"],
+                        default="text",
+                        help="output format: human text (default) or a "
+                             "SARIF 2.1.0 log on stdout")
+    parser.add_argument("--prune-baselines", action="store_true",
+                        help="list [[baseline]] entries that matched "
+                             "nothing in this run (debt paid down); "
+                             "with --fix, delete them from the config")
+    parser.add_argument("--fix", action="store_true",
+                        help="with --prune-baselines: rewrite the "
+                             "config file in place")
     args = parser.parse_args(argv)
+    if args.fix and not args.prune_baselines:
+        parser.error("--fix only makes sense with --prune-baselines")
+    if args.prune_baselines and args.no_baseline:
+        parser.error("--prune-baselines needs the baseline applied "
+                     "(drop --no-baseline)")
 
     import tools.jaxlint.checkers  # noqa: F401  (registration)
 
@@ -1107,12 +1237,51 @@ def main(argv: list[str] | None = None) -> int:
         print(f"ERROR {err}", file=sys.stderr)
     for w in result.warnings:
         print(f"warning: {w}", file=sys.stderr)
-    for f in result.findings:
-        print(f.render())
+    if args.format == "sarif":
+        import json
+
+        print(json.dumps(to_sarif(result), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
     for b in result.stale_baseline:
         print(f"warning: stale baseline entry {b.path} {b.code} "
               f"({b.reason or 'no reason recorded'}) matched nothing",
               file=sys.stderr)
+    if args.prune_baselines:
+        # only entries whose file was actually visited this run can be
+        # judged — a narrow `paths` argument must not condemn the rest
+        # of the ledger
+        root = Path.cwd().resolve()
+        visited = set()
+        for p in iter_python_files(args.paths):
+            try:
+                visited.add(p.resolve().relative_to(root).as_posix())
+            except ValueError:
+                visited.add(p.as_posix())
+        prunable = [b for b in result.stale_baseline if b.path in visited]
+        skipped = len(result.stale_baseline) - len(prunable)
+        if skipped:
+            print(f"prune: {skipped} stale entr"
+                  f"{'ies' if skipped > 1 else 'y'} point outside the "
+                  "linted paths — rerun over the full lint path set to "
+                  "prune them", file=sys.stderr)
+        if not prunable:
+            print("prune: no prunable stale baseline entries")
+        else:
+            for b in prunable:
+                print(f"prune: {b.path} {b.code}"
+                      f"{' match=' + b.match if b.match else ''} "
+                      f"({b.reason or 'no reason recorded'})")
+            if args.fix:
+                _, removed = prune_baselines(args.config, prunable,
+                                             fix=True)
+                print(f"prune: removed {removed} entr"
+                      f"{'ies' if removed != 1 else 'y'} from "
+                      f"{args.config}")
+            else:
+                print(f"prune: {len(prunable)} removable "
+                      "(rerun with --fix to rewrite the config)")
     if args.statistics:
         counts: dict[str, int] = {}
         for f in result.findings:

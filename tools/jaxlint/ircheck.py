@@ -56,6 +56,37 @@ from typing import Callable
 
 from tools.jaxlint.config import IRCheckConfig, load_ircheck_config
 
+
+def ensure_host_device_count(n: int) -> bool:
+    """Make sure at least ``n`` devices exist for a mesh audit, BEFORE
+    jax initializes: appends ``--xla_force_host_platform_device_count``
+    to ``XLA_FLAGS`` (a no-op on real accelerator platforms — the flag
+    only multiplies the host/CPU platform) so the CLI can compile
+    genuine NxM CPU meshes instead of silently clamping to 1x1.
+
+    XLA reads the flag at backend creation, so this only works while
+    ``jax`` is still unimported (every jax import in this module is
+    deliberately lazy for exactly this reason). Returns False when jax
+    is already initialized with fewer devices — the caller decides
+    whether that is a clamp-with-flag or a failure."""
+    if n <= 1:
+        return True
+    if "jax" in sys.modules:
+        import jax
+
+        return len(jax.devices()) >= n
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" in flags:
+        # the caller already chose a count; respect it and let the
+        # mesh build succeed or fail against that choice
+        return True
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}").strip()
+    return True
+
+
 # ------------------------------------------------------------ pure helpers
 # (no jax imports: unit-testable on text/structures alone)
 
@@ -576,7 +607,8 @@ def make_cases() -> dict[str, IRCase]:
 
 def check_case(case: IRCase, ircfg: IRCheckConfig, *,
                mesh_shape: tuple[int, int] = (1, 1),
-               bf16_ready: bool = False, diet: bool = False) -> dict:
+               bf16_ready: bool = False, diet: bool = False,
+               allow_mesh_clamp: bool = False) -> dict:
     """Lower + compile one case and evaluate every contract; returns a
     report dict (``ok``/``failures``/measurements). Never raises — a
     broken build is itself a gate failure."""
@@ -587,10 +619,13 @@ def check_case(case: IRCase, ircfg: IRCheckConfig, *,
     from tools.hbm_budget import hbm_gb_per_step
 
     # a mesh bigger than this box can hold would fail every case in
-    # create_mesh before any contract ran; the axis-NAME audit is
-    # independent of the grid extents (the declared axes are fixed), so
-    # clamp the build mesh and say so. The HBM ledger keys on the mesh
-    # actually compiled.
+    # create_mesh before any contract ran. This used to SILENTLY clamp
+    # to 1x1 — which compiled an unsharded program and skipped the real
+    # audit while printing "ok". The CLI now forces virtual host
+    # devices up front (ensure_host_device_count), so a short box is an
+    # explicit FAILURE unless the caller opts into the clamp
+    # (--allow-mesh-clamp: the axis-NAME audit is still meaningful at
+    # 1x1; nothing else about the sharded program is).
     n_dev = len(jax.devices())
     clamped = mesh_shape[0] * mesh_shape[1] > n_dev
     build_shape = (1, 1) if clamped else mesh_shape
@@ -599,12 +634,22 @@ def check_case(case: IRCase, ircfg: IRCheckConfig, *,
                  "batch": case.batch, "mesh": mesh_str,
                  "platform": jax.default_backend(), "ok": False,
                  "failures": [], "notes": []}
+    if clamped and not allow_mesh_clamp:
+        rep["failures"].append(
+            f"mesh {mesh_shape[0]}x{mesh_shape[1]} needs "
+            f"{mesh_shape[0] * mesh_shape[1]} devices, have {n_dev} — "
+            "refusing to audit a silently-clamped 1x1 program; run the "
+            "CLI (it forces XLA_FLAGS=--xla_force_host_platform_"
+            "device_count before jax loads) or pass --allow-mesh-clamp "
+            "to accept the axis-name-only audit")
+        return rep
     if clamped:
         rep["notes"].append(
             f"mesh {mesh_shape[0]}x{mesh_shape[1]} needs "
             f"{mesh_shape[0] * mesh_shape[1]} devices, have {n_dev} — "
-            "compiling at 1x1 (the collective axis-name audit is "
-            "unaffected; run on a bigger slice for the sharded program)")
+            "compiling at 1x1 (--allow-mesh-clamp: only the collective "
+            "axis-name audit is meaningful; run on a bigger slice or "
+            "under forced host devices for the sharded program)")
     try:
         b1, b2 = case.batch, case.batch * 2
         state, batch1, step_fn = case.build(b1)
@@ -873,7 +918,8 @@ def record_toml(rep: dict) -> str:
 def run(names: list[str] | None = None, *, config: str = "jaxlint.toml",
         fast: bool = False, mesh: tuple[int, int] = (1, 1),
         bf16_ready: bool = False, record: bool = False,
-        diet: bool = False, verbose: bool = False) -> int:
+        diet: bool = False, verbose: bool = False,
+        allow_mesh_clamp: bool = False) -> int:
     ircfg = load_ircheck_config(config)
     cases = make_cases()
     if names:
@@ -907,7 +953,8 @@ def run(names: list[str] | None = None, *, config: str = "jaxlint.toml",
     diet_cuts: list[float] = []
     for case in selected:
         rep = check_case(case, ircfg, mesh_shape=mesh,
-                         bf16_ready=bf16_ready, diet=diet)
+                         bf16_ready=bf16_ready, diet=diet,
+                         allow_mesh_clamp=allow_mesh_clamp)
         models_covered.update(rep["models"])
         status = "ok  " if rep["ok"] else "FAIL"
         gb = rep.get("hbm_gb_per_step", "-")
@@ -1012,16 +1059,26 @@ def main(argv: list[str] | None = None) -> int:
                              "the mixed-precision wire-byte reduction "
                              "against [[ircheck.diet]] floors + the "
                              "registry-median floor")
+    parser.add_argument("--allow-mesh-clamp", action="store_true",
+                        help="accept compiling at 1x1 when the box has "
+                             "fewer devices than --mesh needs (axis-"
+                             "name audit only); the default is to FAIL "
+                             "such cases")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     try:
         n, m = (int(x) for x in args.mesh.split(","))
     except ValueError:
         parser.error(f"--mesh expects N,M (got {args.mesh!r})")
+    # BEFORE any jax import: multiply the host platform so --mesh N,M
+    # compiles a genuine NxM SPMD program on a CPU box instead of the
+    # old silent 1x1 clamp (the flag is read at backend creation)
+    ensure_host_device_count(n * m)
     return run(args.names or None, config=args.config, fast=args.fast,
                mesh=(n, m), bf16_ready=args.bf16_ready,
                record=args.record, diet=args.diet,
-               verbose=args.verbose)
+               verbose=args.verbose,
+               allow_mesh_clamp=args.allow_mesh_clamp)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,559 @@
+"""SPMD sharding & collective-traffic gate: ``python -m tools.jaxlint.shardcheck``.
+
+The fourth tier of the static-analysis stack (AST → interprocedural →
+compiled-IR → SPMD). ircheck proves per-device contracts of the compiled
+train step; this gate proves the *between*-device ones — the properties
+ROADMAP item 1 (partition-rule sharding engine + ZeRO-1) hinges on and
+whose failure modes are silent today: a mistyped partition rule
+replicates a tensor, a sharding mismatch at a pjit boundary inserts an
+all-gather, and nothing ratchets collective bytes the way the
+hbm/wire ledgers ratchet HBM. Four registry-wide contracts, each
+riding ircheck's lower-and-compile harness (``make_cases`` — the REAL
+train step of every registry model) at genuine multi-device CPU meshes
+(``ensure_host_device_count`` forces them before jax loads):
+
+- **collective-byte ledger** — every collective instruction in the
+  optimized SPMD module (all-reduce / all-gather / reduce-scatter /
+  all-to-all / collective-permute) is attributed its output bytes
+  (per participant; async ``-start``/``-done`` pairs counted once,
+  loop bodies once per trace — a relative ledger, like the wire
+  ledger) and the per-(model, mesh, batch) total is gated ±5% against
+  the ``[[shardcheck.comms]]`` baselines in jaxlint.toml. Interconnect
+  traffic only ratchets down consciously.
+- **implicit-resharding detector** — a pure data-parallel
+  replicated-params step compiles to exactly the ``expected_collectives``
+  set (gradient/metric all-reduce). Any OTHER collective opcode is a
+  resharding transfer pjit inserted behind the program's back
+  (producer/consumer sharding mismatch at a jit boundary, GSPMD
+  repair, non-partitionable RNG) and fails the gate unless a reasoned
+  ``[[shardcheck.reshard]]`` waiver declares it deliberate.
+- **partition-rule coverage audit** — the declarative
+  ``[[shardcheck.rule]]`` table (regex leaf-path → PartitionSpec DSL;
+  the format item 1's engine will consume) must match EVERY
+  param/opt-state leaf of every registry model. An unmatched leaf is
+  replicated-by-default — exactly the silent-fallback bug class. The
+  ``--zero1-ready`` mode prints the per-model replicated-residency
+  worklist (f32 master + optimizer-moment bytes that
+  ``core.step.weight_update_sharding`` would shard over the data
+  axis), the ZeRO-1 twin of ``ircheck --bf16-ready``'s f32-surface
+  worklist.
+- **mesh-generalization gate** — each case compiles at every
+  ``mesh_shapes`` entry (≥2 shapes) and the collective structure
+  (opcode set AND instruction counts) must be identical across them: a
+  hardcoded axis size produces a program whose collective set depends
+  on the grid extents, which this catches before any TPU slice does.
+
+Source-level companions JX124–JX126 (tools/jaxlint/checkers.py) keep
+the idioms these proofs rest on out of the source: no hardcoded axis
+names outside core/mesh.py, no unsharded device_put on multi-device
+paths, no inline PartitionSpec in model/step code.
+
+Cost: per case one abstract-state build and one lower+compile per mesh
+shape. The ``fast_models`` subset (``[shardcheck]`` in jaxlint.toml)
+is the `make lint-comms`/tier-1 slice; the registry-wide sweep rides
+``make lint-ir``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import re
+import sys
+import traceback
+from typing import Iterable
+
+from tools.jaxlint.config import ShardCheckConfig, load_shardcheck_config
+from tools.jaxlint.ircheck import IRCase, ensure_host_device_count, make_cases
+
+# ------------------------------------------------------------ pure helpers
+# (no jax imports: unit-testable on HLO text alone)
+
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "all-to-all", "collective-permute")
+
+# `%name = <shape> <opcode>(` — the shape may be a tuple (variadic
+# all-reduce); async pairs appear as <op>-start/<op>-done and must be
+# charged once. Opcode must follow whitespace after the '=' side so
+# instruction NAMES containing an opcode (e.g. %all-reduce.3 on the
+# lhs, or calls=%all-reduce-fusion) never match.
+_COLL_RE = re.compile(
+    r"=\s*(?P<shape>\(?[^=\n]*?)\s+"
+    r"(?P<op>" + "|".join(COLLECTIVE_OPS) + r")"
+    r"(?P<suffix>-start|-done)?\(")
+
+
+def parse_collective_bytes(hlo_text: str) -> dict[str, dict]:
+    """opcode -> {"count", "bytes"} over (layout-stripped) HLO text:
+    every collective instruction charged its OUTPUT bytes (summed over
+    tuple elements for variadic ops) — per-participant bytes of the
+    SPMD module, the number the comms ledger ratchets. ``-done`` halves
+    of async pairs are skipped (the ``-start`` carries the shape)."""
+    from tools.hbm_budget import shape_bytes
+
+    out: dict[str, dict] = {}
+    for m in _COLL_RE.finditer(hlo_text):
+        if m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        nbytes = shape_bytes(m.group("shape"))
+        rec = out.setdefault(op, {"count": 0, "bytes": 0})
+        rec["count"] += 1
+        rec["bytes"] += nbytes
+    return out
+
+
+def parse_mesh(s: str) -> tuple[int, int]:
+    """'2x1' -> (2, 1) — the NxM mesh-string format the toml ledgers
+    key on."""
+    try:
+        n, m = (int(x) for x in s.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"mesh shape must be NxM (got {s!r})") from None
+    if n < 1 or m < 1:
+        raise ValueError(f"mesh extents must be >= 1 (got {s!r})")
+    return n, m
+
+
+def leaf_paths(tree) -> list[tuple[str, object]]:
+    """('/'-joined path, leaf) pairs for a state pytree —
+    ``params/Conv_0/kernel``, ``opt_state/0/mu/Dense_0/bias`` — the
+    path strings the ``[[shardcheck.rule]]`` regexes match against."""
+    import jax
+
+    def seg(k) -> str:
+        for attr in ("name", "key", "idx"):
+            v = getattr(k, attr, None)
+            if v is not None:
+                return str(v)
+        return str(k)
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [("/".join(seg(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def _leaf_bytes(leaf) -> int:
+    import math
+
+    import numpy as np
+
+    shape = getattr(leaf, "shape", ())
+    return (math.prod(shape) if shape else 1) * \
+        np.dtype(leaf.dtype).itemsize
+
+
+def zero1_residency(state, mesh) -> dict:
+    """The ZeRO-1 worklist for one model state: how many bytes sit
+    replicated on every device today that
+    ``core.step.weight_update_sharding`` would shard over the data
+    axis. Keys: ``state_gb`` (whole train state), ``master_f32_gb``
+    (f32 master params — the bf16 diet keeps masters full precision),
+    ``opt_gb`` (optimizer state: Adam/RMSProp moments + counts),
+    ``shardable_gb`` (opt bytes with a data-divisible dim — what
+    ZeRO-1 moves), ``resid_gb`` (per-device opt residency AFTER
+    sharding), ``n_data``."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    from deepvision_tpu.core.mesh import axis_size
+    from deepvision_tpu.core.step import weight_update_sharding
+
+    n_data = axis_size(mesh)
+    specs = weight_update_sharding(state, mesh)
+    is_spec = lambda s: isinstance(s, PartitionSpec)  # noqa: E731
+    opt_leaves = jax.tree.leaves(state.opt_state)
+    opt_specs = jax.tree.leaves(specs.opt_state, is_leaf=is_spec)
+    assert len(opt_leaves) == len(opt_specs)
+    opt_b = sum(_leaf_bytes(x) for x in opt_leaves)
+    shard_b = sum(_leaf_bytes(x)
+                  for x, sp in zip(opt_leaves, opt_specs)
+                  if tuple(sp) != ())
+    master_b = sum(
+        _leaf_bytes(x) for x in jax.tree.leaves(state.params)
+        if str(x.dtype) == "float32")
+    total_b = sum(_leaf_bytes(x) for x in jax.tree.leaves(state))
+    return {
+        "state_gb": round(total_b / 1e9, 3),
+        "master_f32_gb": round(master_b / 1e9, 3),
+        "opt_gb": round(opt_b / 1e9, 3),
+        "shardable_gb": round(shard_b / 1e9, 3),
+        "resid_gb": round(
+            (opt_b - shard_b + shard_b / n_data) / 1e9, 3),
+        "n_data": n_data,
+    }
+
+
+def mesh_consistency(reps: list[dict]) -> list[str]:
+    """The mesh-generalization gate: collective opcode sets AND
+    instruction counts must be identical across every mesh shape a
+    case compiled at — a program whose collective STRUCTURE depends on
+    the grid extents has an axis size baked in somewhere (per-device
+    BYTES legitimately change with the mesh; the ledger rows key on
+    the mesh for exactly that reason). Opcodes covered by a reshard
+    waiver on any mesh are excluded: declared traffic (RNG counter
+    permutes, scatter-index gathers) is partitioner-chosen and MAY
+    differ per grid — that variance is exactly what the waiver's
+    reason explains. Returns failure strings."""
+    done = [r for r in reps if "collectives" in r]
+    if len(done) < 2:
+        return []
+    waived = {op for r in done for op in r.get("waived_ops", ())}
+    probs: list[str] = []
+    ref = done[0]
+    ref_struct = {op: rec["count"]
+                  for op, rec in ref["collectives"].items()
+                  if op not in waived}
+    for r in done[1:]:
+        struct = {op: rec["count"] for op, rec in r["collectives"].items()
+                  if op not in waived}
+        if struct != ref_struct:
+            probs.append(
+                f"collective structure differs across meshes: "
+                f"{ref['mesh']} compiles {ref_struct or '{}'} but "
+                f"{r['mesh']} compiles {struct or '{}'} — an axis "
+                "size is hardcoded somewhere the mesh should "
+                "parameterize")
+    return probs
+
+
+# ----------------------------------------------------------------- checks
+
+
+def check_case(case: IRCase, scfg: ShardCheckConfig, *,
+               mesh_shape: tuple[int, int],
+               audit_rules: bool = True,
+               zero1: bool = False) -> dict:
+    """Lower + compile one case at one mesh shape and evaluate the
+    comms ledger, the resharding detector and (once per case) the
+    partition-rule coverage audit. Never raises — a broken build is
+    itself a gate failure."""
+    import jax
+
+    from deepvision_tpu.core import create_mesh
+    from deepvision_tpu.core.step import compile_train_step
+    from tools.hbm_budget import strip_layouts
+
+    mesh_str = f"{mesh_shape[0]}x{mesh_shape[1]}"
+    rep: dict = {"case": case.name, "models": list(case.models),
+                 "batch": case.batch, "mesh": mesh_str,
+                 "platform": jax.default_backend(), "ok": False,
+                 "failures": [], "notes": []}
+    n_dev = len(jax.devices())
+    need = mesh_shape[0] * mesh_shape[1]
+    if need > n_dev:
+        # no clamping here, ever: an unsharded program has no
+        # collectives to audit and a passing report would be a lie
+        rep["failures"].append(
+            f"mesh {mesh_str} needs {need} devices, have {n_dev} — "
+            "run via the CLI (it forces "
+            "--xla_force_host_platform_device_count before jax loads)")
+        return rep
+    try:
+        state, batch1, step_fn = case.build(case.batch)
+        key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+        mesh = create_mesh(*mesh_shape)
+        step = compile_train_step(step_fn, mesh)
+        compiled = step.lower(state, batch1, key).compile()
+        hlo = strip_layouts(compiled.as_text())
+
+        # (a) collective-byte ledger
+        colls = parse_collective_bytes(hlo)
+        rep["collectives"] = colls
+        coll_gb = round(
+            sum(r["bytes"] for r in colls.values()) / 1e9, 3)
+        rep["coll_gb_per_step"] = coll_gb
+        base = scfg.comms_baseline(case.name, rep["platform"],
+                                   mesh_str, case.batch)
+        if base is None:
+            rep["notes"].append(
+                "no comms baseline for this (platform, mesh, batch) — "
+                "record with --record")
+            rep["comms_unbaselined"] = True
+        else:
+            hi = base.coll_gb_per_step * (1 + scfg.comms_tolerance)
+            lo = base.coll_gb_per_step * (1 - scfg.comms_tolerance)
+            # an all-zero baseline (tiny model: KB of collectives
+            # rounds to 0.0) gates on exact equality of the rounded
+            # number — hi == lo == 0.0 and any growth fails, as it must
+            if coll_gb > hi:
+                rep["failures"].append(
+                    f"coll_gb_per_step {coll_gb} exceeds baseline "
+                    f"{base.coll_gb_per_step} by more than "
+                    f"{scfg.comms_tolerance:.0%} — interconnect bytes "
+                    "only ratchet DOWN; fix the regression or "
+                    "consciously re-record the baseline")
+            elif coll_gb < lo:
+                rep["notes"].append(
+                    f"collective bytes improved "
+                    f"{base.coll_gb_per_step} -> {coll_gb}; re-record "
+                    "to lock the gain in")
+
+        # (b) implicit-resharding detector. The waiver set doubles as
+        # the mesh-generalization comparator's exclusion list: a
+        # waiver on an EXPECTED opcode is never needed here, but it
+        # licenses cross-mesh structure variance on that opcode (the
+        # partitioner re-planning a waived scatter on a 2-axis grid
+        # can shift a neighboring all-reduce count by one).
+        rep["waived_ops"] = []
+        for op in sorted(colls):
+            waiver = scfg.reshard_waiver(case.name, mesh_str, op)
+            for m in case.models:
+                waiver = waiver or scfg.reshard_waiver(m, mesh_str, op)
+            if waiver is not None:
+                waiver.hits += 1
+                rep["waived_ops"].append(op)
+                rep["notes"].append(
+                    f"reshard waived: {op} x{colls[op]['count']} "
+                    f"({colls[op]['bytes'] / 1e6:.1f} MB/step) — "
+                    f"{waiver.reason}")
+            elif any(fnmatch.fnmatch(op, pat)
+                     for pat in scfg.expected_collectives):
+                continue
+            else:
+                rep["failures"].append(
+                    f"implicit reshard: {op} x{colls[op]['count']} "
+                    f"({colls[op]['bytes'] / 1e6:.1f} MB/step) in the "
+                    "compiled module — pjit inserted a resharding "
+                    "transfer the program never asked for (sharding "
+                    "mismatch at a jit boundary, or non-partitionable "
+                    "RNG); fix the shardings or declare it with a "
+                    "reasoned [[shardcheck.reshard]] waiver")
+
+        # (c) partition-rule coverage audit (mesh-independent — run
+        # once per case, on the first mesh)
+        if audit_rules:
+            unmatched: list[str] = []
+            for path, _leaf in leaf_paths(state):
+                rule = scfg.match_rule(path)
+                if rule is None:
+                    unmatched.append(path)
+                else:
+                    rule.hits += 1
+            rep["unmatched_leaves"] = unmatched
+            if unmatched:
+                shown = ", ".join(unmatched[:4])
+                more = (f" (+{len(unmatched) - 4} more)"
+                        if len(unmatched) > 4 else "")
+                rep["failures"].append(
+                    f"partition-rule coverage: {len(unmatched)} state "
+                    f"leaves match no [[shardcheck.rule]] row and "
+                    f"would shard replicated-by-default: {shown}{more} "
+                    "— add a rule (or extend one) so every leaf's "
+                    "sharding is a declared decision")
+
+        if zero1:
+            rep["zero1"] = zero1_residency(state, mesh)
+
+        rep["ok"] = not rep["failures"]
+    # a broken build/lower/compile IS the gate failure being reported
+    except Exception as e:  # jaxlint: disable=JX111
+        rep["failures"].append(f"{type(e).__name__}: {e}")
+        rep["trace"] = traceback.format_exc(limit=10)
+    return rep
+
+
+def record_toml(rep: dict) -> str:
+    """A ready-to-paste ``[[shardcheck.comms]]`` baseline block for one
+    (case, mesh) report."""
+    return (
+        "[[shardcheck.comms]]\n"
+        f'model = "{rep["case"]}"\n'
+        f'platform = "{rep["platform"]}"\n'
+        f'mesh = "{rep["mesh"]}"\n'
+        f"batch = {rep['batch']}\n"
+        f"coll_gb_per_step = {rep['coll_gb_per_step']}\n"
+    )
+
+
+def _print_zero1_table(rows: list[tuple[str, dict]],
+                       hbm_rows: dict[str, float]) -> None:
+    """The ZeRO-1 worklist table: per model, the replicated residency
+    weight-update sharding would move. ``hbm_rows`` maps case name ->
+    the 1x1 cpu ``hbm_gb_per_step`` ledger row for reconciliation
+    (state residency is the floor under that traffic number)."""
+    print("\nzero1-ready: replicated residency the weight-update "
+          "sharding (ZeRO-1) would shard over the data axis")
+    hdr = (f"{'case':16s} {'state':>8s} {'masters':>8s} {'opt':>8s} "
+           f"{'shardable':>9s} {'resid@' + str(rows[0][1]['n_data']) if rows else 'resid':>8s} "
+           f"{'hbm1x1':>8s}")
+    print(hdr)
+    tot = {"state_gb": 0.0, "master_f32_gb": 0.0, "opt_gb": 0.0,
+           "shardable_gb": 0.0, "resid_gb": 0.0}
+    for name, z in rows:
+        for k in tot:
+            tot[k] += z[k]
+        hbm = hbm_rows.get(name)
+        print(f"{name:16s} {z['state_gb']:7.3f}G {z['master_f32_gb']:7.3f}G "
+              f"{z['opt_gb']:7.3f}G {z['shardable_gb']:8.3f}G "
+              f"{z['resid_gb']:7.3f}G "
+              f"{(f'{hbm:7.3f}G' if hbm is not None else '      -')}")
+    print(f"{'TOTAL':16s} {tot['state_gb']:7.3f}G "
+          f"{tot['master_f32_gb']:7.3f}G {tot['opt_gb']:7.3f}G "
+          f"{tot['shardable_gb']:8.3f}G {tot['resid_gb']:7.3f}G")
+    if tot["opt_gb"]:
+        cut = tot["shardable_gb"] * (1 - 1 / max(
+            1, rows[0][1]["n_data"])) if rows else 0.0
+        print(f"zero1-ready: sharding frees {cut:.3f} GB/device of "
+              f"{tot['opt_gb']:.3f} GB optimizer state "
+              f"({tot['shardable_gb']:.3f} GB shardable; masters stay "
+              "replicated until ZeRO-3)")
+
+
+def run(names: list[str] | None = None, *,
+        config: str = "jaxlint.toml", fast: bool = False,
+        meshes: Iterable[str] | None = None, record: bool = False,
+        zero1: bool = False, verbose: bool = False) -> int:
+    scfg = load_shardcheck_config(config)
+    mesh_strs = list(meshes) if meshes else list(scfg.mesh_shapes)
+    mesh_shapes = [parse_mesh(s) for s in mesh_strs]
+    cases = make_cases()
+    if names:
+        unknown = sorted(set(names) - set(cases))
+        if unknown:
+            print(f"unknown case(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(cases))})", file=sys.stderr)
+            return 2
+        selected = [cases[n] for n in names]
+    elif fast:
+        unknown_fast = [n for n in scfg.fast_models if n not in cases]
+        if unknown_fast:
+            print(f"warning: [shardcheck] fast_models entr"
+                  f"{'ies' if len(unknown_fast) > 1 else 'y'} "
+                  f"{unknown_fast} match no case "
+                  f"(known: {', '.join(sorted(cases))})", file=sys.stderr)
+        selected = [cases[n] for n in scfg.fast_models if n in cases]
+        if not selected:
+            print("error: --fast selected ZERO cases — fix [shardcheck] "
+                  "fast_models in jaxlint.toml", file=sys.stderr)
+            return 2
+    else:
+        selected = list(cases.values())
+    failures = 0
+    crashed_models: set[str] = set()
+    models_covered: set[str] = set()
+    to_record: list[str] = []
+    zero1_rows: list[tuple[str, dict]] = []
+    for case in selected:
+        reps: list[dict] = []
+        for i, ms in enumerate(mesh_shapes):
+            rep = check_case(case, scfg, mesh_shape=ms,
+                             audit_rules=(i == 0),
+                             zero1=(zero1 and i == 0))
+            reps.append(rep)
+            models_covered.update(rep["models"])
+            status = "ok  " if rep["ok"] else "FAIL"
+            colls = rep.get("collectives", {})
+            ops = ",".join(f"{op}x{r['count']}"
+                           for op, r in sorted(colls.items())) or "-"
+            print(f"{status} {case.name:16s} b{case.batch:<3d} "
+                  f"mesh={rep['mesh']} "
+                  f"coll={rep.get('coll_gb_per_step', '-')}GB {ops}")
+            for note in rep["notes"]:
+                print(f"     note: {note}")
+            for f in rep["failures"]:
+                print(f"     FAIL: {f}")
+            if verbose and "trace" in rep:
+                print(rep["trace"], file=sys.stderr)
+            if record and "coll_gb_per_step" in rep:
+                to_record.append(record_toml(rep))
+            if "trace" in rep:
+                crashed_models.update({case.name, *case.models})
+            failures += 0 if rep["ok"] else 1
+        for prob in mesh_consistency(reps):
+            print(f"     FAIL: {case.name}: {prob}")
+            failures += 1
+        if zero1 and reps and "zero1" in reps[0]:
+            zero1_rows.append((case.name, reps[0]["zero1"]))
+    # stale-entry warnings: same burn-down contract as every ledger.
+    # Rules are registry-wide, so only a FULL completed sweep may call
+    # one stale; waivers are judged per completed case.
+    sel_models = ({c.name for c in selected}
+                  | {m for c in selected for m in c.models}) \
+        - crashed_models
+    full_sweep = not names and not fast and not crashed_models
+    if full_sweep:
+        for r in scfg.rules:
+            if r.hits == 0:
+                print(f"warning: stale shardcheck.rule {r.pattern!r} "
+                      "matched no state leaf of any registry model — "
+                      "delete or fix the row", file=sys.stderr)
+    for w in scfg.reshard:
+        if w.hits == 0 and w.model in sel_models:
+            print(f"warning: stale shardcheck.reshard waiver "
+                  f"{w.model!r} {w.op!r} ({w.reason}) — nothing "
+                  "matched; delete the entry", file=sys.stderr)
+    if record and to_record:
+        print("\n# paste into jaxlint.toml (recorded comms baselines):")
+        print("\n".join(to_record))
+    if zero1 and zero1_rows:
+        from tools.jaxlint.config import load_ircheck_config
+
+        ircfg = load_ircheck_config(config)
+        hbm_rows = {
+            c.name: b.hbm_gb_per_step
+            for c in selected
+            for b in [ircfg.hbm_baseline(c.name, "cpu", "1x1", c.batch)]
+            if b is not None
+        }
+        _print_zero1_table(zero1_rows, hbm_rows)
+    n = len(selected) * len(mesh_shapes)
+    print(f"shardcheck: {n - failures}/{n} case-mesh compiles pass "
+          f"({len(models_covered)} registry models, "
+          f"meshes {','.join(mesh_strs)})")
+    return 1 if failures else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint.shardcheck",
+        description="SPMD sharding & collective-traffic gate over the "
+                    "model registry (comms-byte ledger / implicit-"
+                    "reshard detector / partition-rule coverage / "
+                    "mesh-generalization; tools/jaxlint/shardcheck.py)",
+    )
+    parser.add_argument("names", nargs="*",
+                        help="case names (default: every registry case)")
+    parser.add_argument("--config", default="jaxlint.toml")
+    parser.add_argument("--fast", action="store_true",
+                        help="only the [shardcheck] fast_models subset "
+                             "(the `make lint-comms` slice)")
+    parser.add_argument("--mesh", default=None,
+                        help="comma-separated NxM mesh shapes to audit "
+                             "(default: [shardcheck] mesh_shapes, "
+                             "2x1,2x2); >=2 shapes arm the mesh-"
+                             "generalization gate")
+    parser.add_argument("--record", action="store_true",
+                        help="print paste-ready [[shardcheck.comms]] "
+                             "TOML for every measured (case, mesh)")
+    parser.add_argument("--zero1-ready", action="store_true",
+                        help="print the per-model replicated-residency "
+                             "worklist ZeRO-1 would shard (ROADMAP "
+                             "item-1 twin of ircheck --bf16-ready)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    meshes = ([s.strip() for s in args.mesh.split(",") if s.strip()]
+              if args.mesh else None)
+    try:
+        shapes = [parse_mesh(s) for s in
+                  (meshes or load_shardcheck_config(
+                      args.config).mesh_shapes)]
+    except ValueError as e:
+        parser.error(str(e))
+    # BEFORE any jax import (every jax import in this module and in
+    # ircheck is lazy for exactly this): force enough virtual host
+    # devices for the largest requested mesh
+    if not ensure_host_device_count(
+            max(n * m for n, m in shapes)):
+        print("error: jax is already initialized with too few devices "
+              "for the requested meshes — launch a fresh process (the "
+              "CLI sets XLA_FLAGS only before jax loads)",
+              file=sys.stderr)
+        return 2
+    return run(args.names or None, config=args.config, fast=args.fast,
+               meshes=meshes, record=args.record,
+               zero1=args.zero1_ready, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
