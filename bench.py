@@ -914,6 +914,180 @@ def precision_bench() -> dict:
     return out
 
 
+# ---- ZeRO-1 A/B (`python bench.py zero1`) -------------------------------
+# Fast-set models compiled replicated vs under the sharding engine's
+# ZeRO-1 specs, at both lint-tier grids; residency is MEASURED from the
+# stepped state's addressable shards, then reconciled against the
+# shardcheck zero1_residency prediction — the lint tier's worklist
+# numbers and the hardware must tell the same story.
+ZERO1_MODELS = [m for m in os.environ.get(
+    "BENCH_ZERO1_MODELS", "lenet5,dcgan").split(",") if m]
+ZERO1_MESHES = ((2, 1), (2, 2))
+ZERO1_STEPS = 2  # enough to materialize a stepped opt state per arm
+
+
+def _zero1_case(name):
+    """(state, batch, step_fn) for one A/B case — CONCRETE arrays (the
+    residency numbers come from real device shards, not shape math) at
+    the shipped config's geometry, batch pinned small: the measurement
+    is placement, not throughput."""
+    from functools import partial
+
+    import jax.numpy as jnp
+
+    from deepvision_tpu.core.precision import get_policy
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train import steps as S
+    from deepvision_tpu.train.configs import get_config
+    from deepvision_tpu.train.optimizers import make_optimizer
+    from deepvision_tpu.train.state import create_train_state
+
+    rng = np.random.default_rng(0)
+    if name == "dcgan":
+        # the non-TrainState family: both GAN subtrees shard through
+        # the same Zero1Plan (train/gan.py)
+        from deepvision_tpu.train.gan import (
+            create_dcgan_state,
+            dcgan_train_step,
+        )
+
+        batch = {"image": rng.normal(size=(64, 28, 28, 1))
+                 .astype(np.float32)}
+        state = create_dcgan_state(
+            get_model("dcgan_generator", dtype=jnp.bfloat16),
+            get_model("dcgan_discriminator", dtype=jnp.bfloat16))
+        return state, batch, dcgan_train_step
+
+    cfg = get_config(name)
+    policy = get_policy(cfg["precision"])
+    size, ch = cfg["input_size"], cfg["channels"]
+    model = get_model(name, num_classes=cfg["num_classes"],
+                      dtype=policy.compute_dtype,
+                      **cfg.get("model_kwargs", {}))
+    tx, _ = make_optimizer(cfg, steps_per_epoch=100)
+    batch = {
+        "image": rng.normal(size=(64, size, size, ch)).astype(np.float32),
+        "label": rng.integers(0, cfg["num_classes"],
+                              size=(64,)).astype(np.int32),
+    }
+    norm = "torch" if cfg.get("augment") == "pt" else "imagenet"
+    state = create_train_state(model, tx, batch["image"][:1],
+                               policy=policy)
+    return state, batch, partial(S.classification_train_step,
+                                 normalize_kind=norm)
+
+
+def _zero1_arm(name, mesh_shape, *, zero1: bool, rules):
+    """Build fresh, compile (under the engine's ZeRO-1 state specs when
+    asked), run ZERO1_STEPS, then read the truth off the devices:
+    per-device opt-state bytes from the stepped state's addressable
+    shards, per-device HBM traffic from the executable's cost analysis,
+    collective bytes from its HLO. Returns (report, raw opt bytes on
+    device 0)."""
+    from deepvision_tpu.core import create_mesh, shard_batch
+    from deepvision_tpu.core.sharding import (
+        state_partition_specs,
+        zero1_plan as make_zero1_plan,
+    )
+    from deepvision_tpu.core.step import compile_train_step
+    from tools.hbm_budget import strip_layouts
+    from tools.jaxlint.shardcheck import parse_collective_bytes
+
+    state, batch, step_fn = _zero1_case(name)
+    mesh = create_mesh(*mesh_shape)
+    state_spec = None
+    if zero1:
+        plan = make_zero1_plan(mesh, rules=rules)
+        if plan is None:
+            raise RuntimeError(
+                "the [[shardcheck.rule]] opt_state row does not "
+                "prescribe largest(...) — nothing to A/B")
+        state = state.replace(zero1_plan=plan)
+        state_spec = state_partition_specs(state, mesh, zero1=True,
+                                           rules=rules)
+    step = compile_train_step(step_fn, mesh, state_spec=state_spec)
+    db = shard_batch(mesh, batch)
+    key = jax.random.key(0)
+    compiled = step.lower(state, db, key).compile()
+    for _ in range(ZERO1_STEPS):
+        key, sub = jax.random.split(key)
+        state, _metrics = compiled(state, db, sub)
+    jax.block_until_ready(state)
+
+    dev = jax.devices()[0]
+    opt_b = 0
+    for leaf in jax.tree.leaves(state.opt_state):
+        for sh in leaf.addressable_shards:
+            if sh.device == dev:  # dev0's resident bytes for this leaf
+                opt_b += sh.data.nbytes
+                break
+    colls = parse_collective_bytes(strip_layouts(compiled.as_text()))
+    return {
+        "hbm_gb_per_step": round(
+            float(_cost_analysis(compiled).get("bytes accessed", 0))
+            / 1e9, 3),
+        "opt_gb_per_device": round(opt_b / 1e9, 4),
+        "coll_gb_per_step": round(
+            sum(r["bytes"] for r in colls.values()) / 1e9, 3),
+    }, opt_b
+
+
+def zero1_bench() -> dict:
+    """``bench.py zero1`` — the ISSUE 17 acceptance A/B as ONE JSON
+    row: each fast-set model compiled replicated vs under the engine's
+    ZeRO-1 specs at 2x1 and 2x2, reporting cost-analysis
+    ``hbm_gb_per_step``, measured per-device opt-state residency and
+    collective bytes side by side, and reconciling the measured ZeRO-1
+    residency against shardcheck's ``zero1_residency`` prediction
+    within ±5% (floored at 1 MB — the ledger's rounding quantum, which
+    dominates at lenet scale). ``BENCH_ZERO1_MODELS`` overrides the
+    model set for on-chip runs."""
+    from deepvision_tpu.core import create_mesh
+    from deepvision_tpu.core.sharding import load_partition_rules
+    from tools.jaxlint.shardcheck import zero1_residency
+
+    rules = load_partition_rules()
+    n_dev = len(jax.devices())
+    models: dict = {}
+    all_ok = True
+    for name in ZERO1_MODELS:
+        per_mesh: dict = {}
+        for mesh_shape in ZERO1_MESHES:
+            mesh_str = f"{mesh_shape[0]}x{mesh_shape[1]}"
+            need = mesh_shape[0] * mesh_shape[1]
+            if need > n_dev:
+                per_mesh[mesh_str] = {
+                    "skipped": f"needs {need} devices, have {n_dev}"}
+                continue
+            state, _b, _s = _zero1_case(name)
+            pred = zero1_residency(state, create_mesh(*mesh_shape))
+            del state
+            repl, repl_b = _zero1_arm(name, mesh_shape, zero1=False,
+                                      rules=rules)
+            z1, z1_b = _zero1_arm(name, mesh_shape, zero1=True,
+                                  rules=rules)
+            pred_b = pred["resid_gb"] * 1e9
+            ok = abs(z1_b - pred_b) <= max(0.05 * pred_b, 1e6)
+            all_ok = all_ok and ok
+            per_mesh[mesh_str] = {
+                "replicated": repl,
+                "zero1": z1,
+                "opt_freed_gb_per_device": round(
+                    (repl_b - z1_b) / 1e9, 4),
+                "shardcheck_residency": pred,
+                "resid_reconciled_5pct": ok,
+            }
+        models[name] = per_mesh
+    return {
+        "metric": "zero1_ab",
+        "models": models,
+        "steps_per_arm": ZERO1_STEPS,
+        "device_kind": jax.devices()[0].device_kind,
+        "gates": {"resid_reconciled_5pct": all_ok},
+        "obs": _obs_snapshot(),
+    }
+
+
 def _sync_scalar(state) -> None:
     """Drain the dispatch queue through the full dependency chain (the
     same full-chain sync the headline bench uses — block_until_ready on
@@ -1751,6 +1925,18 @@ if __name__ == "__main__":
             print(json.dumps(precision_bench()))
         elif "sentinel" in sys.argv[1:]:
             print(json.dumps(sentinel_bench()))
+        elif "zero1" in sys.argv[1:]:
+            # the 2x2 arm needs 4 devices: land the host-platform
+            # device-count flag before the FIRST backend init (jax is
+            # imported above but stays uninitialized until a device
+            # query — same trick as tests/conftest.py); a no-op on real
+            # accelerator platforms
+            _flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in _flags:
+                os.environ["XLA_FLAGS"] = (
+                    _flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            print(json.dumps(zero1_bench()))
         elif "serve" in sys.argv[1:]:
             if "--sweep" in sys.argv[1:]:
                 print(json.dumps(serve_sweep_bench()))

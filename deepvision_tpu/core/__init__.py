@@ -1,3 +1,17 @@
+import jax
+
+# Partitionable threefry, repo-wide: counter-based PRNG sampling that
+# partitions with the data it feeds, so per-example key splits over a
+# sharded batch no longer compile to cross-shard collective-permutes of
+# key counters (the ~9 [[shardcheck.reshard]] RNG waivers this flag
+# retired — probe: dcgan 14 permutes -> 0). Bit-behavior contract
+# (tests/test_sharding.py pins it): seed->key construction and fold_in
+# (the epoch/host derivations) produce identical key_data; split-derived
+# subkeys (KeySeq draws) and every sampled stream re-roll — the one-time
+# re-roll accepted when the flag flipped (jax upstream flips the same
+# default in 0.5).
+jax.config.update("jax_threefry_partitionable", True)
+
 from deepvision_tpu.core.mesh import (
     AXIS_DATA,
     AXIS_MODEL,

@@ -186,7 +186,7 @@ def compile_checked_train_step(
     return run
 
 
-def weight_update_sharding(state, mesh: Mesh, *, axis: str = AXIS_DATA):
+def weight_update_sharding(state, mesh: Mesh):
     """ZeRO-1-style optimizer-state sharding spec for ``state``.
 
     Implements the TPU technique from "Automatic Cross-Replica Sharding
@@ -198,30 +198,18 @@ def weight_update_sharding(state, mesh: Mesh, *, axis: str = AXIS_DATA):
     reduce-scatter + all-gather and cutting optimizer memory per chip by
     the axis size.
 
+    Thin wrapper over the partition-rule engine: the specs come from the
+    ``[[shardcheck.rule]]`` table with its ``largest(data)`` rows active
+    (``core.sharding.state_partition_specs(zero1=True)``) — the same
+    table shardcheck audits and the same interpreter checkpoint restore
+    re-shards with, so there is exactly one answer to "how does this
+    state shard".
+
     Returns a pytree of PartitionSpecs shaped like ``state`` for
-    ``compile_train_step(state_spec=...)``: each optimizer-state leaf is
-    sharded on its first dimension divisible by the axis size; params /
+    ``compile_train_step(state_spec=...)``: each optimizer-state leaf
+    sharded on its largest data-divisible dimension; params /
     batch_stats / step stay replicated.
     """
-    n = mesh.shape[axis]
+    from deepvision_tpu.core.sharding import state_partition_specs
 
-    def leaf_spec(x):
-        shape = getattr(x, "shape", ())
-        # shard the LARGEST divisible dim: for a (8, 4096) leaf with n=8,
-        # splitting dim 1 leaves 512x less per-chip state to re-gather
-        # than splitting dim 0 (r2 review finding — the first divisible
-        # dim was picked arbitrarily before)
-        best = None
-        for dim, extent in enumerate(shape):
-            if extent >= n and extent % n == 0:
-                if best is None or extent > shape[best]:
-                    best = dim
-        if best is None:
-            return P()
-        return P(*([None] * best), axis,
-                 *([None] * (len(shape) - best - 1)))
-
-    specs = jax.tree.map(lambda _: P(), state)
-    return specs.replace(
-        opt_state=jax.tree.map(leaf_spec, state.opt_state)
-    )
+    return state_partition_specs(state, mesh, zero1=True)

@@ -1,0 +1,159 @@
+"""Dependency-free TOML-subset reader.
+
+The repo's Python is 3.10 (no stdlib ``tomllib``) and the container's
+dependency set is frozen, so this module carries a deliberately minimal
+TOML-subset reader covering exactly what ``jaxlint.toml`` uses: comments,
+``[table]`` / ``[[array-of-tables]]`` headers (dotted keys allowed),
+and ``key = value`` with string / number / bool / list-of-scalars values
+(lists may span lines). Anything fancier (inline tables, dates, escapes
+beyond ``\\"`` and ``\\\\``) is rejected loudly rather than misread.
+
+It lives in the library (not ``tools/``) because the declarative
+``[[shardcheck.rule]]`` partition table is consumed at RUNTIME by the
+sharding engine (core/sharding.py) as well as at lint time by
+``tools/jaxlint/config.py`` — one reader, one dialect, no drift between
+what the trainer shards and what the lint tier audits. It imports
+nothing beyond the stdlib, so the AST-only jaxlint path stays free of a
+jax import.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class TomlError(ValueError):
+    pass
+
+
+_BARE_KEY = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+
+def _parse_scalar(tok: str, where: str):
+    tok = tok.strip()
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        body = tok[1:-1]
+        # the only escapes jaxlint.toml needs
+        return body.replace('\\"', '"').replace("\\\\", "\\")
+    if tok.startswith("'") and tok.endswith("'") and len(tok) >= 2:
+        return tok[1:-1]
+    if tok in ("true", "false"):
+        return tok == "true"
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        raise TomlError(f"{where}: unsupported TOML value {tok!r}") from None
+
+
+def _split_list_items(body: str, where: str) -> list[str]:
+    """Split a [...] body on commas that are outside quotes
+    (backslash-escape aware within basic strings)."""
+    items, cur, quote, escaped = [], "", None, False
+    for ch in body:
+        if quote:
+            cur += ch
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote == '"':
+                escaped = True
+            elif ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            cur += ch
+        elif ch == ",":
+            items.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if quote:
+        raise TomlError(f"{where}: unterminated string in list")
+    items.append(cur)
+    return [i.strip() for i in items if i.strip()]
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing comment; '#' inside quotes (incl. after an
+    escaped quote like ``"a \\" # b"``) is content, not a comment."""
+    quote, escaped = None, False
+    for i, ch in enumerate(line):
+        if quote:
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote == '"':
+                escaped = True
+            elif ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
+def loads_toml(text: str) -> dict:
+    """Parse the TOML subset described in the module docstring."""
+    root: dict = {}
+    current = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        raw = _strip_comment(lines[i]).strip()
+        i += 1
+        if not raw:
+            continue
+        where = f"line {i}"
+        if raw.startswith("[["):  # array of tables
+            if not raw.endswith("]]"):
+                raise TomlError(f"{where}: malformed table header {raw!r}")
+            name = raw[2:-2].strip()
+            parent = _descend(root, name, where)
+            arr = parent.setdefault(name.split(".")[-1], [])
+            if not isinstance(arr, list):
+                raise TomlError(f"{where}: {name!r} redefined as an array")
+            current = {}
+            arr.append(current)
+        elif raw.startswith("["):
+            if not raw.endswith("]"):
+                raise TomlError(f"{where}: malformed table header {raw!r}")
+            name = raw[1:-1].strip()
+            parent = _descend(root, name, where)
+            current = parent.setdefault(name.split(".")[-1], {})
+            if not isinstance(current, dict):
+                raise TomlError(f"{where}: {name!r} redefined as a table")
+        else:
+            if "=" not in raw:
+                raise TomlError(f"{where}: expected key = value, got {raw!r}")
+            key, _, val = raw.partition("=")
+            key, val = key.strip(), val.strip()
+            if not _BARE_KEY.match(key):
+                raise TomlError(f"{where}: unsupported key {key!r}")
+            if val.startswith("["):
+                # accumulate a possibly multiline list
+                while val.count("[") > val.count("]"):
+                    if i >= len(lines):
+                        raise TomlError(f"{where}: unterminated list")
+                    val += " " + _strip_comment(lines[i]).strip()
+                    i += 1
+                body = val.strip()[1:-1]
+                current[key] = [
+                    _parse_scalar(t, where)
+                    for t in _split_list_items(body, where)
+                ]
+            else:
+                current[key] = _parse_scalar(val, where)
+    return root
+
+
+def _descend(root: dict, dotted: str, where: str) -> dict:
+    node = root
+    parts = dotted.split(".")
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+        if not isinstance(node, dict):
+            raise TomlError(f"{where}: {part!r} is not a table")
+    return node

@@ -292,7 +292,24 @@ class CheckpointManager:
     @staticmethod
     def _payload(state) -> dict:
         """The checkpointed pytree. GAN states carry pools/etc. in an
-        ``extra_vars`` field mirrored here (train/gan.py)."""
+        ``extra_vars`` field mirrored here (train/gan.py).
+
+        Under ZeRO-1 (core/sharding.py) the ``opt_state`` leaves are
+        data-axis-sharded jax.Arrays: Orbax serializes global arrays
+        shard-wise, so each host persists only its LOCAL opt_state
+        shards (no gather on the save path), and a restore template
+        built from an already-sharded state restores straight into the
+        shards. A template built from a FRESH (replicated) state — the
+        resume path, possibly at a different host count — restores the
+        full logical arrays instead; Trainer._reshard_state then
+        re-shards them onto the new mesh, which is what makes elastic
+        resume across host counts deterministic: same logical bytes,
+        re-cut to whatever the mesh now prescribes. The PR 4 integrity
+        manifests hash whatever files the save committed (shard files
+        included); the PR 10 audited fingerprints stay
+        params+batch_stats only (resilience/sentinel.py) — opt_state
+        shards legitimately differ per host and must never trip a
+        false SDC divergence."""
         payload = {
             "params": state.params,
             "batch_stats": state.batch_stats,

@@ -61,6 +61,9 @@ class GANState:
     d_tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
     noise_dim: int = flax.struct.field(pytree_node=False, default=100)
     loss_scale: Any = None
+    # core.sharding.Zero1Plan when fit_gan turned on weight-update
+    # sharding; static (hashable) — same contract as TrainState's.
+    zero1_plan: Any = flax.struct.field(pytree_node=False, default=None)
 
     def scale_loss(self, loss):
         """Loss scaled for a backward (identity without a scaler)."""
@@ -102,6 +105,15 @@ def _gan_apply_gradients(state: "GANState", g_grads, d_grads, *,
         tree_select,
     )
 
+    # ZeRO-1 reduce-scatter point (core.sharding.Zero1Plan, same
+    # bracketing as TrainState.apply_gradients): both tapes' grads and
+    # updates pinned to the weight-update sharding, updated params
+    # all-gathered back to replicated. The plan is shape-driven, so one
+    # plan serves both subtrees.
+    plan = state.zero1_plan
+    if plan is not None:
+        g_grads, d_grads = plan.shard_update(g_grads), \
+            plan.shard_update(d_grads)
     ls = state.loss_scale
     new_ls, finite = None, None
     if ls is not None:
@@ -117,8 +129,13 @@ def _gan_apply_gradients(state: "GANState", g_grads, d_grads, *,
         g_grads, state.opt_state["generator"], g_params)
     d_up, d_opt = state.d_tx.update(
         d_grads, state.opt_state["discriminator"], d_params)
-    new_params = assemble(optax.apply_updates(g_params, g_up),
-                          optax.apply_updates(d_params, d_up))
+    if plan is not None:
+        g_up, d_up = plan.shard_update(g_up), plan.shard_update(d_up)
+    new_gp = optax.apply_updates(g_params, g_up)
+    new_dp = optax.apply_updates(d_params, d_up)
+    if plan is not None:
+        new_gp, new_dp = plan.replicate(new_gp), plan.replicate(new_dp)
+    new_params = assemble(new_gp, new_dp)
     new_opt = {"generator": g_opt, "discriminator": d_opt}
     new_ev = state.extra_vars if extra_vars is None else extra_vars
     if ls is not None:
@@ -531,8 +548,16 @@ def fit_gan(
             loggers = meta["loggers"]
     state_spec = None
     if shard_weight_update:
+        from deepvision_tpu.core.sharding import zero1_plan
         from deepvision_tpu.core.step import weight_update_sharding
 
+        plan = zero1_plan(mesh)
+        if plan is None:
+            raise ValueError(
+                "--zero1 asked for weight-update sharding but the "
+                "[[shardcheck.rule]] opt_state row does not prescribe a "
+                "largest(...) spec — declare it in the table first")
+        state = state.replace(zero1_plan=plan)
         state_spec = weight_update_sharding(state, mesh)
     compiler = (
         compile_checked_train_step if check_numerics else compile_train_step

@@ -47,13 +47,27 @@ class TrainState:
     # (an EMPTY pytree — leaf list unchanged for every pre-policy
     # checkpoint and donation-alignment contract) otherwise.
     loss_scale: Any = None
+    # core.sharding.Zero1Plan when the trainer turned on cross-replica
+    # weight-update sharding (arXiv:2004.13336); None = replicated
+    # update. Static: the plan is hashable (mesh + rule DSL string) and
+    # part of the jit cache key, not a pytree leaf.
+    zero1_plan: Any = flax.struct.field(pytree_node=False, default=None)
 
     def apply_gradients(self, grads, *, batch_stats=None) -> "TrainState":
+        # ZeRO-1 reduce-scatter point: grads constrained to the
+        # weight-update sharding BEFORE any use, so XLA reduces each
+        # gradient straight into its local shard (the replicated
+        # all-reduce never materializes). Elementwise unscale / zero /
+        # finiteness below all preserve the sharding; opt_state enters
+        # and leaves sharded via compile_train_step's state_spec.
+        plan = self.zero1_plan
+        if plan is not None:
+            grads = plan.shard_update(grads)
         if self.loss_scale is None:
             updates, new_opt_state = self.tx.update(
                 grads, self.opt_state, self.params
             )
-            new_params = optax.apply_updates(self.params, updates)
+            new_params = self._apply_updates(updates)
             return self.replace(
                 step=self.step + 1,
                 params=new_params,
@@ -65,7 +79,9 @@ class TrainState:
         # divide the scale back out (and cast up to the f32 masters),
         # then gate the whole update on grad finiteness: a non-finite
         # step is SKIPPED (masters, optimizer state and BN stats all
-        # keep their pre-step values) while the scale backs off.
+        # keep their pre-step values — under ZeRO-1 every opt_state
+        # SHARD selects its own pre-step slice, so no shard moves)
+        # while the scale backs off.
         ls = self.loss_scale
         grads = ls.unscale(grads)
         finite = all_finite(grads)
@@ -79,7 +95,7 @@ class TrainState:
         updates, new_opt_state = self.tx.update(
             safe_grads, self.opt_state, self.params
         )
-        new_params = optax.apply_updates(self.params, updates)
+        new_params = self._apply_updates(updates)
         new_bs = self.batch_stats if batch_stats is None else batch_stats
         return self.replace(
             step=self.step + 1,
@@ -89,6 +105,17 @@ class TrainState:
             if batch_stats is not None else self.batch_stats,
             loss_scale=new_ls,
         )
+
+    def _apply_updates(self, updates):
+        """``optax.apply_updates`` with the ZeRO-1 bracketing: updates
+        pinned to the weight-update sharding (each replica adds only
+        its own parameter slice), result all-gathered back to the
+        replicated masters the next forward reads."""
+        if self.zero1_plan is None:
+            return optax.apply_updates(self.params, updates)
+        updates = self.zero1_plan.shard_update(updates)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.zero1_plan.replicate(new_params)
 
     def scale_loss(self, loss: jax.Array) -> jax.Array:
         """Loss scaled for the backward (identity without a scaler) —

@@ -193,6 +193,31 @@ class Trainer:
         self.policy = get_policy(config.get("precision", "bf16"))
         self.state = create_train_state(model, self.tx, sample, rng=seed,
                                         policy=self.policy)
+        state_spec = None
+        if shard_weight_update:
+            # ZeRO-1 (arXiv:2004.13336): optimizer state + the weight
+            # update sharded over the data axis. Plan and state specs
+            # both come from the [[shardcheck.rule]] table via the
+            # partition-rule engine (core/sharding.py); the plan rides
+            # the state as a STATIC field so apply_gradients places the
+            # reduce-scatter/all-gather. Attached before any host copy
+            # of the state (recovery's _init_state) so every rollback /
+            # restore template carries the same static plan — a
+            # plan-less state would silently retrace a replicated
+            # update program.
+            from deepvision_tpu.core.sharding import zero1_plan
+            from deepvision_tpu.core.step import weight_update_sharding
+
+            plan = zero1_plan(mesh)
+            if plan is None:
+                raise ValueError(
+                    "--zero1 asked for weight-update sharding but the "
+                    "[[shardcheck.rule]] opt_state row does not "
+                    "prescribe a largest(...) spec — declare it in the "
+                    "table first")
+            self.state = self.state.replace(zero1_plan=plan)
+            state_spec = weight_update_sharding(self.state, mesh)
+        self._state_spec = state_spec
         # self-healing (resilience/): with a RecoveryPolicy the checkify
         # NaN/Inf tripwire becomes rollback-and-skip instead of a crash,
         # transient data reads retry with backoff, and resume verifies
@@ -225,14 +250,6 @@ class Trainer:
             # a host-side copy of the pristine initial state. Costs one
             # state-sized host buffer — the price of epoch-0 recovery.
             self._init_state = jax.tree.map(np.asarray, self.state)
-        state_spec = None
-        if shard_weight_update:
-            # ZeRO-1 analog: optimizer state + weight update sharded over
-            # the data axis (core/step.weight_update_sharding)
-            from deepvision_tpu.core.step import weight_update_sharding
-
-            state_spec = weight_update_sharding(self.state, mesh)
-        self._state_spec = state_spec
         if check_numerics:  # NaN/Inf tripwire (SURVEY §5.2)
             from deepvision_tpu.core.step import compile_checked_train_step
 
@@ -641,13 +658,10 @@ class Trainer:
         VERDICT r4 weak #6). No-op for replicated (default) runs."""
         if self._state_spec is None:
             return
-        from jax.sharding import NamedSharding, PartitionSpec
+        from deepvision_tpu.core.sharding import make_shard_and_gather_fns
 
-        shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), self._state_spec,
-            is_leaf=lambda s: isinstance(s, PartitionSpec),
-        )
-        self.state = jax.device_put(self.state, shardings)
+        shard_fn, _ = make_shard_and_gather_fns(self._state_spec, self.mesh)
+        self.state = shard_fn(self.state)
 
     def _resume_from_preempt(self, allow_clear: bool = True) -> bool:
         """Restore the newest mid-epoch preemption checkpoint (from

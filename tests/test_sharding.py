@@ -1,0 +1,375 @@
+"""Partition-rule sharding engine + ZeRO-1 tests (ISSUE 17).
+
+Three layers, matching deepvision_tpu/core/sharding.py:
+
+- the DSL interpreter and rule loader (pure, cheap);
+- the repo's own [[shardcheck.rule]] table consumed end-to-end
+  (trainer and lint tier read the SAME rows — parity pinned here);
+- ZeRO-1 (arXiv:2004.13336) through the real train step: sharded
+  weight update vs replicated twin at pinned tolerance, the
+  loss-scale skip composition, sharded-checkpoint elastic re-shard,
+  and the threefry_partitionable bit-behavior contract the flag flip
+  (deepvision_tpu/core/__init__.py) relies on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepvision_tpu.core import KeySeq, create_mesh, shard_batch
+from deepvision_tpu.core.sharding import (
+    RULES_ENV,
+    PartitionRule,
+    RuleError,
+    Zero1Plan,
+    leaf_paths,
+    load_partition_rules,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    parse_leaf_spec,
+    state_partition_specs,
+    zero1_plan,
+)
+
+
+# ------------------------------------------------------------ DSL + loader
+
+
+def test_parse_leaf_spec_dsl(mesh8):
+    # mesh8 is 8x1: data=8, model=1
+    assert parse_leaf_spec("replicated", (16, 4), mesh8) == P()
+    assert parse_leaf_spec("data,*", (16, 4), mesh8) == P("data")
+    assert parse_leaf_spec("*,data", (4, 16), mesh8) == P(None, "data")
+    # ragged named dim -> whole leaf replicated (fallback, not error)
+    assert parse_leaf_spec("data,*", (6, 4), mesh8) == P()
+    # largest(axis): the biggest axis-divisible dim is sharded
+    assert parse_leaf_spec("largest(data)", (8, 4096), mesh8) == \
+        P(None, "data")
+    assert parse_leaf_spec("largest(data)", (3, 3, 64, 64), mesh8) == \
+        P(None, None, "data", None)
+    assert parse_leaf_spec("largest(data)", (3,), mesh8) == P()
+    # zero1=False renders the largest() row as a declared WORKLIST
+    assert parse_leaf_spec("largest(data)", (8, 4096), mesh8,
+                           zero1=False) == P()
+    with pytest.raises(RuleError, match="mesh axis"):
+        parse_leaf_spec("tensor,*", (16, 4), mesh8)
+    with pytest.raises(RuleError, match="rank"):
+        parse_leaf_spec("data,*,*", (16, 4), mesh8)
+
+
+def test_repo_rule_table_loads_and_prescribes_zero1():
+    """The engine reads the SAME [[shardcheck.rule]] rows the lint
+    tier audits — and the tools-side loader agrees row-for-row."""
+    from tools.jaxlint.config import load_shardcheck_config
+
+    rules = load_partition_rules()
+    assert rules, "repo jaxlint.toml must carry [[shardcheck.rule]] rows"
+    scfg = load_shardcheck_config("jaxlint.toml")
+    assert [(r.pattern, r.spec) for r in rules] == \
+        [(r.pattern, r.spec) for r in scfg.rules]
+    # the opt_state row IS the ZeRO-1 prescription
+    opt = next(r for r in rules if r.matches("opt_state"))
+    assert opt.spec.startswith("largest(")
+
+
+def test_rule_table_env_override_and_missing(tmp_path, monkeypatch):
+    table = tmp_path / "rules.toml"
+    table.write_text(
+        '[[shardcheck.rule]]\npattern = "."\nspec = "replicated"\n')
+    monkeypatch.setenv(RULES_ENV, str(table))
+    rules = load_partition_rules()
+    assert len(rules) == 1 and rules[0].spec == "replicated"
+    monkeypatch.setenv(RULES_ENV, str(tmp_path / "nope.toml"))
+    with pytest.raises(RuleError, match="does not exist"):
+        load_partition_rules()
+    table.write_text("# empty\n")
+    monkeypatch.setenv(RULES_ENV, str(table))
+    with pytest.raises(RuleError, match="no \\[\\[shardcheck.rule\\]\\]"):
+        load_partition_rules()
+
+
+def test_match_partition_rules_first_match_wins(mesh8):
+    rules = (PartitionRule(pattern=r"^a/b", spec="data,*"),
+             PartitionRule(pattern=r"^a", spec="replicated"),
+             PartitionRule(pattern=r".", spec="replicated"))
+    tree = {"a": {"b": np.zeros((16, 4), np.float32),
+                  "c": np.zeros((16, 4), np.float32)},
+            "d": np.zeros((3,), np.float32)}
+    specs = match_partition_rules(rules, tree, mesh8)
+    assert specs["a"]["b"] == P("data")
+    assert specs["a"]["c"] == P()
+    assert specs["d"] == P()
+
+
+def test_match_partition_rules_unmatched_raises(mesh8):
+    rules = (PartitionRule(pattern=r"^a/", spec="replicated"),)
+    tree = {"a": {"x": np.zeros((2,))}, "orphan": np.zeros((2,))}
+    with pytest.raises(RuleError, match="orphan"):
+        match_partition_rules(rules, tree, mesh8)
+
+
+def test_state_specs_zero1_off_is_all_replicated(mesh8):
+    """Without zero1 the engine must reproduce the pre-engine world:
+    every leaf replicated, so existing compiles are bit-unchanged."""
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.state import create_train_state
+
+    model = get_model("lenet5", num_classes=10)
+    state = create_train_state(
+        model, optax.adam(1e-3), np.zeros((1, 32, 32, 1), np.float32))
+    specs = state_partition_specs(state, mesh8, zero1=False)
+    assert all(s == P() for s in jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)))
+    # zero1=True shards at least one optimizer moment
+    z1 = state_partition_specs(state, mesh8, zero1=True)
+    assert any(s != P() for s in jax.tree.leaves(
+        z1.opt_state, is_leaf=lambda x: isinstance(x, P)))
+    # params/batch_stats stay replicated either way (ZeRO-1, not ZeRO-3)
+    assert all(s == P() for s in jax.tree.leaves(
+        z1.params, is_leaf=lambda x: isinstance(x, P)))
+
+
+def test_leaf_paths_dialect():
+    tree = {"params": {"Conv_0": {"kernel": np.zeros((1,))}},
+            "opt": (np.zeros((1,)), {"mu": np.zeros((1,))})}
+    paths = [p for p, _ in leaf_paths(tree)]
+    assert "params/Conv_0/kernel" in paths
+    assert "opt/0" in paths
+    assert "opt/1/mu" in paths
+
+
+def test_shard_and_gather_roundtrip(mesh8):
+    tree = {"big": np.arange(8 * 32, dtype=np.float32).reshape(8, 32),
+            "tiny": np.arange(3, dtype=np.float32)}
+    specs = {"big": P(None, "data"), "tiny": P()}
+    shard_fn, gather_fn = make_shard_and_gather_fns(specs, mesh8)
+    sharded = shard_fn(tree)
+    assert sharded["big"].sharding.spec == P(None, "data")
+    assert sharded["big"].addressable_shards[0].data.shape == (8, 4)
+    back = gather_fn(sharded)
+    np.testing.assert_array_equal(back["big"], tree["big"])
+    np.testing.assert_array_equal(back["tiny"], tree["tiny"])
+
+
+def test_zero1_plan_from_repo_table(mesh8):
+    plan = zero1_plan(mesh8)
+    assert isinstance(plan, Zero1Plan)
+    assert plan.spec == "largest(data)"
+    assert hash(plan) == hash(Zero1Plan(mesh=mesh8, spec="largest(data)"))
+    assert plan.leaf_sharding((8, 4096)).spec == P(None, "data")
+    assert plan.leaf_sharding((3,)).spec == P()
+    # a table whose opt_state row is NOT largest() -> no plan
+    rules = (PartitionRule(pattern=r".", spec="replicated"),)
+    assert zero1_plan(mesh8, rules=rules) is None
+
+
+# ----------------------------------------------- threefry bit-behavior pin
+
+
+def test_threefry_partitionable_is_on():
+    """The repo-wide flag flip (deepvision_tpu/core/__init__.py) that
+    retired the RNG collective-permute reshard waivers."""
+    assert jax.config.jax_threefry_partitionable
+
+
+def test_threefry_flip_confined_to_sampling():
+    """The bit-behavior contract of the flip: seed->key construction
+    and fold_in (epoch/host stream derivations) are IDENTICAL under
+    both modes — so checkpointed keys and resume replay stay valid —
+    while split-derived subkeys and sampled streams re-roll (the
+    accepted one-time change)."""
+    def probe():
+        k = jax.random.key(0)
+        return (np.asarray(jax.random.key_data(k)),
+                np.asarray(jax.random.key_data(jax.random.fold_in(k, 7))),
+                np.asarray(jax.random.key_data(jax.random.split(k, 2))),
+                np.asarray(jax.random.normal(k, (4,))))
+
+    on = probe()
+    try:
+        jax.config.update("jax_threefry_partitionable", False)
+        off = probe()
+    finally:
+        jax.config.update("jax_threefry_partitionable", True)
+    np.testing.assert_array_equal(on[0], off[0])   # key construction
+    np.testing.assert_array_equal(on[1], off[1])   # fold_in derivation
+    assert not np.array_equal(on[2], off[2])       # split re-rolls
+    assert not np.array_equal(on[3], off[3])       # samples re-roll
+
+
+def test_keyseq_replay_deterministic_under_flag():
+    """KeySeq.skip's elastic-resume replay contract survives the flip:
+    draws are deterministic per seed, and skip(n) lands the chain
+    exactly where n discarded draws would."""
+    a, b = KeySeq(42), KeySeq(42)
+    for _ in range(3):
+        next(b)
+    b_four = next(b)
+    for _ in range(3):
+        next(a)
+    np.testing.assert_array_equal(
+        jax.random.key_data(next(a)), jax.random.key_data(b_four))
+    c = KeySeq(42).skip(3)
+    np.testing.assert_array_equal(
+        jax.random.key_data(next(c)), jax.random.key_data(b_four))
+
+
+# ------------------------------------------------------- ZeRO-1 end-to-end
+
+
+def _fit_lenet(mesh, batches, *, zero1):
+    """The real machinery end-to-end: bf16_scaled policy (dynamic loss
+    scaling — the PR 15 skip path ZeRO-1 must compose with), the real
+    classification step, engine specs as compile-time out-shardings."""
+    from deepvision_tpu.core.precision import get_policy
+    from deepvision_tpu.core.step import compile_train_step
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.state import create_train_state
+    from deepvision_tpu.train.steps import classification_train_step
+
+    model = get_model("lenet5", num_classes=10)
+    state = create_train_state(
+        model, optax.adam(1e-3), batches[0]["image"][:1],
+        policy=get_policy("bf16_scaled"))
+    state_spec = None
+    if zero1:
+        state = state.replace(zero1_plan=zero1_plan(mesh))
+        state_spec = state_partition_specs(state, mesh, zero1=True)
+    step = compile_train_step(classification_train_step, mesh,
+                              state_spec=state_spec)
+    key = jax.random.key(0)
+    snaps = []
+    for i, b in enumerate(batches):
+        # host snapshots: the compiled step DONATES the state buffers,
+        # so the pre-step values must be copied out before the call
+        prev = (_host(state.params), _mu_leaves(state))
+        state, metrics = step(state, shard_batch(mesh, b),
+                              jax.random.fold_in(key, i))
+        snaps.append((prev, (_host(state.params), _mu_leaves(state)),
+                      metrics))
+    return state, snaps
+
+
+def _host(tree):
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def _mu_leaves(state):
+    return [np.asarray(x) for x in jax.tree.leaves(state.opt_state[0].mu)]
+
+
+@pytest.mark.slow
+def test_zero1_parity_and_loss_scale_skip():
+    """The ISSUE 17 acceptance contract on an NxM CPU mesh: ZeRO-1 vs
+    replicated final state bit-comparable at pinned tolerance across a
+    run that INCLUDES a loss-scale skip step — and the skip leaves
+    every optimizer shard untouched, exactly as it leaves the
+    replicated moments untouched."""
+    mesh = create_mesh(4, 2)
+    r = np.random.default_rng(0)
+    batches = [{
+        "image": r.normal(size=(16, 32, 32, 1)).astype(np.float32),
+        "label": r.integers(0, 10, 16).astype(np.int32),
+    } for _ in range(4)]
+    batches[2]["image"][0, 0, 0, 0] = np.inf  # forces non-finite grads
+
+    base, base_snaps = _fit_lenet(mesh, batches, zero1=False)
+    z1, z1_snaps = _fit_lenet(mesh, batches, zero1=True)
+
+    for snaps in (base_snaps, z1_snaps):
+        (prev_p, prev_mu), (after_p, after_mu), metrics = snaps[2]
+        assert float(metrics["mp_grads_finite"]) == 0.0
+        # skip semantics: masters AND every moment (shard) frozen
+        for a, b in zip(prev_p, after_p):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(prev_mu, after_mu):
+            np.testing.assert_array_equal(a, b)
+
+    # pinned tolerance, not bit-equality: sharding the update changes
+    # the gradient-reduction summation order (measured max diff ~6e-8)
+    assert float(z1_snaps[-1][2]["loss"]) == pytest.approx(
+        float(base_snaps[-1][2]["loss"]), rel=1e-4)
+    for a, b in zip(jax.tree.leaves(base.params),
+                    jax.tree.leaves(z1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+    # the optimizer state is genuinely distributed: sharded storage on
+    # the returned arrays (compile_train_step's out-shardings), and
+    # per-device moment bytes actually cut by the data-axis extent
+    sharded = [x for x in jax.tree.leaves(z1.opt_state[0].mu)
+               if not x.sharding.is_fully_replicated]
+    assert sharded, "no mu leaf stored sharded under --zero1"
+    for arr in sharded:
+        assert arr.addressable_shards[0].data.nbytes * \
+            mesh.shape["data"] == arr.nbytes
+    # replicated twin keeps fully-replicated moments
+    assert all(x.sharding.is_fully_replicated
+               for x in jax.tree.leaves(base.opt_state[0].mu))
+
+
+@pytest.mark.slow
+def test_checkpoint_elastic_reshard_roundtrip(tmp_path):
+    """Elastic-resume contract: a state saved with ZeRO-1-sharded
+    opt_state restores into a fresh replicated template and re-shards
+    DETERMINISTICALLY at a different mesh layout — same bytes, new
+    shard boundaries (deepvision_tpu/train/checkpoint.py contract)."""
+    from deepvision_tpu.models import get_model
+    from deepvision_tpu.train.checkpoint import CheckpointManager
+    from deepvision_tpu.train.state import create_train_state
+
+    model = get_model("lenet5", num_classes=10)
+
+    def fresh():
+        return create_train_state(
+            model, optax.adam(1e-3), np.zeros((1, 32, 32, 1), np.float32))
+
+    mesh_a = create_mesh(4, 2)
+    state = fresh()
+    ref = [np.asarray(x) for x in jax.tree.leaves(state)]
+    shard_a, _ = make_shard_and_gather_fns(
+        state_partition_specs(state, mesh_a, zero1=True), mesh_a)
+    mgr = CheckpointManager(tmp_path / "ckpt", integrity=True)
+    mgr.save(0, shard_a(state))
+    mgr.wait_until_finished()
+
+    # restore into a replicated template (the different-host-count
+    # bootstrap: the saved layout no longer matches), then re-shard
+    restored, _meta = mgr.restore(fresh(), 0)
+    mesh_b = create_mesh(2, 1)
+    specs_b = state_partition_specs(restored, mesh_b, zero1=True)
+    shard_b, gather_b = make_shard_and_gather_fns(specs_b, mesh_b)
+    resharded = shard_b(restored)
+    for got, want in zip(jax.tree.leaves(gather_b(resharded)), ref):
+        np.testing.assert_array_equal(np.asarray(got), want)
+    for arr, spec in zip(
+            jax.tree.leaves(resharded.opt_state),
+            jax.tree.leaves(specs_b.opt_state,
+                            is_leaf=lambda s: isinstance(s, P))):
+        assert arr.sharding.spec == spec
+    mgr.close()
+
+
+def test_fingerprint_excludes_opt_state_under_zero1():
+    """The cross-host audit fingerprints params+batch_stats ONLY: a
+    ZeRO-1-sharded opt_state is legitimately different per host, so a
+    moment perturbation must NOT flip the digest (while a param
+    perturbation must)."""
+    from deepvision_tpu.resilience.sentinel import SentinelMonitor
+
+    mon = SentinelMonitor()
+
+    class S:
+        params = {"w": np.ones((4, 4), np.float32)}
+        batch_stats = {"bn": {"mean": np.zeros((4,), np.float32)}}
+        opt_state = ({"mu": np.ones((4, 4), np.float32)},)
+
+    a = mon.fingerprint_state(S())
+    tampered = S()
+    tampered.opt_state = ({"mu": np.full((4, 4), 9.0, np.float32)},)
+    assert mon.fingerprint_state(tampered)["digest"] == a["digest"]
+    bad = S()
+    bad.params = {"w": np.full((4, 4), 2.0, np.float32)}
+    assert mon.fingerprint_state(bad)["digest"] != a["digest"]

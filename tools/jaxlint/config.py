@@ -1,13 +1,9 @@
 """jaxlint configuration: ``jaxlint.toml`` loading + the LintConfig model.
 
-The repo's Python is 3.10 (no stdlib ``tomllib``) and the container's
-dependency set is frozen, so this module carries a deliberately minimal
-TOML-subset reader covering exactly what ``jaxlint.toml`` uses: comments,
-``[table]`` / ``[[array-of-tables]]`` headers (dotted keys allowed),
-and ``key = value`` with string / number / bool / list-of-scalars values
-(lists may span lines). Anything fancier (inline tables, dates, escapes
-beyond ``\\"`` and ``\\\\``) is rejected loudly rather than misread.
-"""
+The TOML-subset reader lives in ``deepvision_tpu/minitoml.py`` (shared
+with the runtime sharding engine, which consumes the same
+``[[shardcheck.rule]]`` table — one reader, one dialect); this module
+re-exports it and carries the config dataclasses + loaders."""
 
 from __future__ import annotations
 
@@ -16,144 +12,10 @@ import re
 from dataclasses import dataclass, field
 from pathlib import Path
 
-# --------------------------------------------------------------- TOML subset
-
-
-class TomlError(ValueError):
-    pass
-
-
-_BARE_KEY = re.compile(r"^[A-Za-z0-9_.\-]+$")
-
-
-def _parse_scalar(tok: str, where: str):
-    tok = tok.strip()
-    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
-        body = tok[1:-1]
-        # the only escapes jaxlint.toml needs
-        return body.replace('\\"', '"').replace("\\\\", "\\")
-    if tok.startswith("'") and tok.endswith("'") and len(tok) >= 2:
-        return tok[1:-1]
-    if tok in ("true", "false"):
-        return tok == "true"
-    try:
-        return int(tok)
-    except ValueError:
-        pass
-    try:
-        return float(tok)
-    except ValueError:
-        raise TomlError(f"{where}: unsupported TOML value {tok!r}") from None
-
-
-def _split_list_items(body: str, where: str) -> list[str]:
-    """Split a [...] body on commas that are outside quotes
-    (backslash-escape aware within basic strings)."""
-    items, cur, quote, escaped = [], "", None, False
-    for ch in body:
-        if quote:
-            cur += ch
-            if escaped:
-                escaped = False
-            elif ch == "\\" and quote == '"':
-                escaped = True
-            elif ch == quote:
-                quote = None
-        elif ch in "\"'":
-            quote = ch
-            cur += ch
-        elif ch == ",":
-            items.append(cur)
-            cur = ""
-        else:
-            cur += ch
-    if quote:
-        raise TomlError(f"{where}: unterminated string in list")
-    items.append(cur)
-    return [i.strip() for i in items if i.strip()]
-
-
-def _strip_comment(line: str) -> str:
-    """Drop a trailing comment; '#' inside quotes (incl. after an
-    escaped quote like ``"a \\" # b"``) is content, not a comment."""
-    quote, escaped = None, False
-    for i, ch in enumerate(line):
-        if quote:
-            if escaped:
-                escaped = False
-            elif ch == "\\" and quote == '"':
-                escaped = True
-            elif ch == quote:
-                quote = None
-        elif ch in "\"'":
-            quote = ch
-        elif ch == "#":
-            return line[:i]
-    return line
-
-
-def loads_toml(text: str) -> dict:
-    """Parse the TOML subset described in the module docstring."""
-    root: dict = {}
-    current = root
-    lines = text.splitlines()
-    i = 0
-    while i < len(lines):
-        raw = _strip_comment(lines[i]).strip()
-        i += 1
-        if not raw:
-            continue
-        where = f"line {i}"
-        if raw.startswith("[["):  # array of tables
-            if not raw.endswith("]]"):
-                raise TomlError(f"{where}: malformed table header {raw!r}")
-            name = raw[2:-2].strip()
-            parent = _descend(root, name, where)
-            arr = parent.setdefault(name.split(".")[-1], [])
-            if not isinstance(arr, list):
-                raise TomlError(f"{where}: {name!r} redefined as an array")
-            current = {}
-            arr.append(current)
-        elif raw.startswith("["):
-            if not raw.endswith("]"):
-                raise TomlError(f"{where}: malformed table header {raw!r}")
-            name = raw[1:-1].strip()
-            parent = _descend(root, name, where)
-            current = parent.setdefault(name.split(".")[-1], {})
-            if not isinstance(current, dict):
-                raise TomlError(f"{where}: {name!r} redefined as a table")
-        else:
-            if "=" not in raw:
-                raise TomlError(f"{where}: expected key = value, got {raw!r}")
-            key, _, val = raw.partition("=")
-            key, val = key.strip(), val.strip()
-            if not _BARE_KEY.match(key):
-                raise TomlError(f"{where}: unsupported key {key!r}")
-            if val.startswith("["):
-                # accumulate a possibly multiline list
-                while val.count("[") > val.count("]"):
-                    if i >= len(lines):
-                        raise TomlError(f"{where}: unterminated list")
-                    val += " " + _strip_comment(lines[i]).strip()
-                    i += 1
-                body = val.strip()[1:-1]
-                current[key] = [
-                    _parse_scalar(t, where)
-                    for t in _split_list_items(body, where)
-                ]
-            else:
-                current[key] = _parse_scalar(val, where)
-    return root
-
-
-def _descend(root: dict, dotted: str, where: str) -> dict:
-    node = root
-    parts = dotted.split(".")
-    for part in parts[:-1]:
-        node = node.setdefault(part, {})
-        if not isinstance(node, dict):
-            raise TomlError(f"{where}: {part!r} is not a table")
-    return node
+# Re-exported names (loads_toml / TomlError were defined here before the
+# sharding engine moved the reader into the library): existing importers
+# (core.py, tests) keep working unchanged.
+from deepvision_tpu.minitoml import TomlError, loads_toml  # noqa: F401
 
 
 # ------------------------------------------------------------- LintConfig
@@ -645,8 +507,10 @@ class PartitionRule:
     """One row of the declarative sharding rules table
     (``[[shardcheck.rule]]``): a regex over '/'-joined state-leaf paths
     (``params/Conv_0/kernel``, ``opt_state/0/mu/Dense_0/bias`` …) and
-    the PartitionSpec it prescribes. ``spec`` is a tiny DSL the ROADMAP
-    item-1 sharding engine will interpret:
+    the PartitionSpec it prescribes. ``spec`` is a tiny DSL whose ONE
+    interpreter is the runtime sharding engine
+    (``deepvision_tpu/core/sharding.py`` — trainer, checkpoint restore
+    and shardcheck's ZeRO-1 compile all call it):
 
     - ``"replicated"`` — ``P()`` on every matched leaf
     - ``"data"`` / ``"data,*"`` … — per-dim axis entries (``*`` = None)
@@ -674,7 +538,12 @@ class CommsBaseline:
     every collective instruction (all-reduce / all-gather /
     reduce-scatter / all-to-all / collective-permute) in the optimized
     SPMD module — per-participant bytes, the ratchet twin of the
-    ``[[ircheck.hbm]]`` rows for the interconnect."""
+    ``[[ircheck.hbm]]`` rows for the interconnect.
+
+    ``zero1 = true`` rows key the ZeRO-1 compile (``shardcheck
+    --zero1``): the weight-update sharding legitimately trades
+    all-reduce for reduce-scatter/all-gather traffic, so replicated
+    and ZeRO-1 programs ratchet against separate baselines."""
 
     model: str
     platform: str
@@ -682,6 +551,7 @@ class CommsBaseline:
     coll_gb_per_step: float
     mesh: str = "2x1"
     note: str = ""
+    zero1: bool = False
 
 
 @dataclass
@@ -732,10 +602,11 @@ class ShardCheckConfig:
     reshard: list[ReshardWaiver] = field(default_factory=list)
 
     def comms_baseline(self, model: str, platform: str, mesh: str,
-                       batch: int) -> CommsBaseline | None:
+                       batch: int, *,
+                       zero1: bool = False) -> CommsBaseline | None:
         for b in self.comms:
-            if (b.model, b.platform, b.mesh, b.batch) == \
-                    (model, platform, mesh, batch):
+            if (b.model, b.platform, b.mesh, b.batch, b.zero1) == \
+                    (model, platform, mesh, batch, zero1):
                 return b
         return None
 
@@ -802,6 +673,7 @@ def load_shardcheck_config(path: str | Path | None) -> ShardCheckConfig:
             coll_gb_per_step=float(entry["coll_gb_per_step"]),
             mesh=str(entry.get("mesh", "2x1")),
             note=str(entry.get("note", "")),
+            zero1=bool(entry.get("zero1", False)),
         ))
     for entry in table.get("reshard", []):
         for req in ("model", "op"):

@@ -1136,19 +1136,37 @@ def prune_baselines(config_path: str | Path,
                     stale: list[BaselineEntry], *,
                     fix: bool = False) -> tuple[str, int]:
     """Drop the ``[[baseline]]`` blocks for ``stale`` entries from the
-    config text, preserving every other byte (the loader's round-trip
-    twin is deliberately NOT used — comments and formatting are the
-    ledger's documentation). A block's contiguous leading comment
-    paragraph goes with it. Returns (new_text, removed_count); writes
-    the file only when ``fix``."""
+    config text — see :func:`prune_blocks` for the mechanics."""
+    return prune_blocks(
+        config_path, "baseline",
+        {(b.path, b.code, b.match) for b in stale},
+        lambda e: (e.get("path", ""), e.get("code", ""),
+                   e.get("match", "")),
+        fix=fix)
+
+
+def prune_blocks(config_path: str | Path, header: str,
+                 keys: set, key_of, *,
+                 fix: bool = False) -> tuple[str, int]:
+    """Drop the ``[[<header>]]`` blocks whose ``key_of(entry)`` is in
+    ``keys`` from the config text, preserving every other byte (the
+    loader's round-trip twin is deliberately NOT used — comments and
+    formatting are the ledger's documentation). A block's contiguous
+    leading comment paragraph goes with it. Shared by the AST
+    ``[[baseline]]`` pruner and shardcheck's ``--prune-waivers``
+    (``[[shardcheck.reshard]]``). Returns (new_text, removed_count);
+    writes the file only when ``fix``."""
     text = Path(config_path).read_text()
     lines = text.splitlines(keepends=True)
-    keys = {(b.path, b.code, b.match) for b in stale}
+    marker = f"[[{header}]]"
+    # dotted headers parse into nested tables: [[shardcheck.reshard]]
+    # loads as data["shardcheck"]["reshard"][0]
+    parts = header.split(".")
     removed = 0
     drop: set[int] = set()
     i = 0
     while i < len(lines):
-        if lines[i].strip() != "[[baseline]]":
+        if lines[i].strip() != marker:
             i += 1
             continue
         j = i + 1
@@ -1160,12 +1178,14 @@ def prune_baselines(config_path: str | Path,
         while end > i + 1 and not lines[end - 1].strip():
             end -= 1
         try:
-            entry = loads_toml("".join(lines[i:end]))["baseline"][0]
+            node = loads_toml("".join(lines[i:end]))
+            for p in parts:
+                node = node[p]
+            entry = node[0]
         except (TomlError, KeyError, IndexError):
             i = j
             continue
-        key = (entry.get("path", ""), entry.get("code", ""),
-               entry.get("match", ""))
+        key = key_of(entry)
         if key in keys:
             removed += 1
             start = i

@@ -36,7 +36,12 @@ train step of every registry model) at genuine multi-device CPU meshes
   worklist (f32 master + optimizer-moment bytes that
   ``core.step.weight_update_sharding`` would shard over the data
   axis), the ZeRO-1 twin of ``ircheck --bf16-ready``'s f32-surface
-  worklist.
+  worklist. ``--zero1`` goes further: it compiles every case under
+  the engine's ZeRO-1 specs (``deepvision_tpu/core/sharding.py`` —
+  the same interpreter the trainer runs) and PROVES conversion by
+  reading the storage shardings back out of
+  ``compiled.output_shardings``; the worklist is empty only when
+  every prescribed opt-state leaf is stored sharded.
 - **mesh-generalization gate** — each case compiles at every
   ``mesh_shapes`` entry (≥2 shapes) and the collective structure
   (opcode set AND instruction counts) must be identical across them: a
@@ -118,19 +123,13 @@ def parse_mesh(s: str) -> tuple[int, int]:
 def leaf_paths(tree) -> list[tuple[str, object]]:
     """('/'-joined path, leaf) pairs for a state pytree —
     ``params/Conv_0/kernel``, ``opt_state/0/mu/Dense_0/bias`` — the
-    path strings the ``[[shardcheck.rule]]`` regexes match against."""
-    import jax
+    path strings the ``[[shardcheck.rule]]`` regexes match against.
+    Delegates to the runtime engine so the audit and the trainer can
+    never disagree on the path dialect (import stays lazy: this module
+    must be importable jax-free for the HLO-text unit tests)."""
+    from deepvision_tpu.core.sharding import leaf_paths as _engine_paths
 
-    def seg(k) -> str:
-        for attr in ("name", "key", "idx"):
-            v = getattr(k, attr, None)
-            if v is not None:
-                return str(v)
-        return str(k)
-
-    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-    return [("/".join(seg(k) for k in path), leaf)
-            for path, leaf in flat]
+    return _engine_paths(tree)
 
 
 def _leaf_bytes(leaf) -> int:
@@ -223,14 +222,32 @@ def mesh_consistency(reps: list[dict]) -> list[str]:
 def check_case(case: IRCase, scfg: ShardCheckConfig, *,
                mesh_shape: tuple[int, int],
                audit_rules: bool = True,
-               zero1: bool = False) -> dict:
+               zero1: bool = False,
+               zero1_compile: bool = False) -> dict:
     """Lower + compile one case at one mesh shape and evaluate the
     comms ledger, the resharding detector and (once per case) the
     partition-rule coverage audit. Never raises — a broken build is
-    itself a gate failure."""
+    itself a gate failure.
+
+    ``zero1_compile`` compiles under the engine's ZeRO-1 state specs
+    (``state_partition_specs(..., zero1=True)`` as the pjit
+    out-shardings) and then PROVES the conversion from the compiled
+    executable: every opt-state leaf the ``largest(...)`` rule
+    prescribes sharded must come back non-replicated in
+    ``compiled.output_shardings`` — the ``--zero1-ready`` worklist is
+    empty only when the storage sharding is real, not merely asked
+    for. Comms baselines are keyed separately (``zero1 = true`` rows):
+    the update's reduce-scatter/all-gather is declared traffic here,
+    not an implicit reshard."""
     import jax
 
     from deepvision_tpu.core import create_mesh
+    from deepvision_tpu.core.sharding import (
+        RuleError,
+        parse_leaf_spec,
+        state_partition_specs,
+        zero1_plan as make_zero1_plan,
+    )
     from deepvision_tpu.core.step import compile_train_step
     from tools.hbm_budget import strip_layouts
 
@@ -253,7 +270,21 @@ def check_case(case: IRCase, scfg: ShardCheckConfig, *,
         state, batch1, step_fn = case.build(case.batch)
         key = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
         mesh = create_mesh(*mesh_shape)
-        step = compile_train_step(step_fn, mesh)
+        state_spec = None
+        if zero1_compile:
+            plan = make_zero1_plan(mesh, rules=scfg.rules)
+            if plan is None:
+                rep["failures"].append(
+                    "--zero1 compile asked for weight-update sharding "
+                    "but the [[shardcheck.rule]] opt_state row does not "
+                    "prescribe a largest(...) spec — nothing to verify")
+                return rep
+            if hasattr(state, "zero1_plan"):
+                state = state.replace(zero1_plan=plan)
+            state_spec = state_partition_specs(
+                state, mesh, zero1=True, rules=scfg.rules)
+            rep["zero1_compile"] = True
+        step = compile_train_step(step_fn, mesh, state_spec=state_spec)
         compiled = step.lower(state, batch1, key).compile()
         hlo = strip_layouts(compiled.as_text())
 
@@ -264,7 +295,8 @@ def check_case(case: IRCase, scfg: ShardCheckConfig, *,
             sum(r["bytes"] for r in colls.values()) / 1e9, 3)
         rep["coll_gb_per_step"] = coll_gb
         base = scfg.comms_baseline(case.name, rep["platform"],
-                                   mesh_str, case.batch)
+                                   mesh_str, case.batch,
+                                   zero1=zero1_compile)
         if base is None:
             rep["notes"].append(
                 "no comms baseline for this (platform, mesh, batch) — "
@@ -296,7 +328,25 @@ def check_case(case: IRCase, scfg: ShardCheckConfig, *,
         # partitioner re-planning a waived scatter on a 2-axis grid
         # can shift a neighboring all-reduce count by one).
         rep["waived_ops"] = []
+        # under a ZeRO-1 compile the update's collective swap is the
+        # declared plan, not an implicit reshard: reduce-scatter (grads
+        # into local shards), all-gather (updated params back out), and
+        # whatever shard shuffles the partitioner plans between them
+        # (permutes/all-to-alls on 2-axis grids; the scatter half even
+        # lowers as all-reduce+slice on this CPU backend). The reshard
+        # DETECTOR therefore lives in the default replicated compile —
+        # under --zero1 the teeth are the separately-keyed byte ledger
+        # and the storage-sharding proof below.
+        zero1_expected = ({"all-gather", "reduce-scatter",
+                           "collective-permute", "all-to-all"}
+                          if zero1_compile else set())
         for op in sorted(colls):
+            if op in zero1_expected:
+                rep["notes"].append(
+                    f"zero1: {op} x{colls[op]['count']} "
+                    f"({colls[op]['bytes'] / 1e6:.1f} MB/step) is the "
+                    "declared weight-update traffic")
+                continue
             waiver = scfg.reshard_waiver(case.name, mesh_str, op)
             for m in case.models:
                 waiver = waiver or scfg.reshard_waiver(m, mesh_str, op)
@@ -324,12 +374,23 @@ def check_case(case: IRCase, scfg: ShardCheckConfig, *,
         # once per case, on the first mesh)
         if audit_rules:
             unmatched: list[str] = []
-            for path, _leaf in leaf_paths(state):
+            bad_specs: list[str] = []
+            for path, leaf in leaf_paths(state):
                 rule = scfg.match_rule(path)
                 if rule is None:
                     unmatched.append(path)
-                else:
-                    rule.hits += 1
+                    continue
+                rule.hits += 1
+                try:
+                    # the spec must INTERPRET against the real leaf
+                    # shape, not merely parse: a rule naming too many
+                    # dims or an axis the mesh lacks is a coverage lie
+                    # the regex match alone would hide
+                    parse_leaf_spec(
+                        rule.spec, tuple(getattr(leaf, "shape", ())),
+                        mesh, zero1=True)
+                except RuleError as e:
+                    bad_specs.append(f"{path} ({rule.spec!r}): {e}")
             rep["unmatched_leaves"] = unmatched
             if unmatched:
                 shown = ", ".join(unmatched[:4])
@@ -341,6 +402,47 @@ def check_case(case: IRCase, scfg: ShardCheckConfig, *,
                     f"would shard replicated-by-default: {shown}{more} "
                     "— add a rule (or extend one) so every leaf's "
                     "sharding is a declared decision")
+            if bad_specs:
+                shown = "; ".join(bad_specs[:3])
+                more = (f" (+{len(bad_specs) - 3} more)"
+                        if len(bad_specs) > 3 else "")
+                rep["failures"].append(
+                    f"partition-rule specs uninterpretable against "
+                    f"{len(bad_specs)} matched leaves: {shown}{more}")
+
+        # (d) ZeRO-1 conversion proof: read the STORAGE shardings back
+        # out of the compiled executable and require every opt-state
+        # leaf the engine prescribed sharded to actually be sharded —
+        # the worklist-empty gate for --zero1-ready
+        if zero1_compile:
+            from jax.sharding import PartitionSpec
+
+            is_spec = lambda s: isinstance(s, PartitionSpec)  # noqa: E731
+            out_state = compiled.output_shardings[0]
+            paths = [p for p, _ in leaf_paths(state)]
+            specs_flat = jax.tree.leaves(state_spec, is_leaf=is_spec)
+            out_flat = jax.tree.leaves(out_state)
+            assert len(paths) == len(specs_flat) == len(out_flat)
+            pending = [
+                p for p, sp, osh in zip(paths, specs_flat, out_flat)
+                if tuple(sp) != () and osh.is_fully_replicated]
+            n_sharded = sum(1 for sp in specs_flat if tuple(sp) != ())
+            rep["zero1_pending"] = pending
+            rep["zero1_sharded_leaves"] = n_sharded - len(pending)
+            if pending:
+                shown = ", ".join(pending[:4])
+                more = (f" (+{len(pending) - 4} more)"
+                        if len(pending) > 4 else "")
+                rep["failures"].append(
+                    f"zero1 worklist NOT empty: {len(pending)} leaves "
+                    f"the engine prescribed sharded came back "
+                    f"replicated in the compiled output shardings: "
+                    f"{shown}{more}")
+            else:
+                rep["notes"].append(
+                    f"zero1 worklist empty: all {n_sharded} prescribed "
+                    "opt-state leaves stored sharded in the compiled "
+                    "executable")
 
         if zero1:
             rep["zero1"] = zero1_residency(state, mesh)
@@ -363,6 +465,7 @@ def record_toml(rep: dict) -> str:
         f'mesh = "{rep["mesh"]}"\n'
         f"batch = {rep['batch']}\n"
         f"coll_gb_per_step = {rep['coll_gb_per_step']}\n"
+        + ("zero1 = true\n" if rep.get("zero1_compile") else "")
     )
 
 
@@ -403,7 +506,9 @@ def _print_zero1_table(rows: list[tuple[str, dict]],
 def run(names: list[str] | None = None, *,
         config: str = "jaxlint.toml", fast: bool = False,
         meshes: Iterable[str] | None = None, record: bool = False,
-        zero1: bool = False, verbose: bool = False) -> int:
+        zero1: bool = False, zero1_compile: bool = False,
+        prune_waivers: bool = False, fix: bool = False,
+        verbose: bool = False) -> int:
     scfg = load_shardcheck_config(config)
     mesh_strs = list(meshes) if meshes else list(scfg.mesh_shapes)
     mesh_shapes = [parse_mesh(s) for s in mesh_strs]
@@ -439,7 +544,8 @@ def run(names: list[str] | None = None, *,
         for i, ms in enumerate(mesh_shapes):
             rep = check_case(case, scfg, mesh_shape=ms,
                              audit_rules=(i == 0),
-                             zero1=(zero1 and i == 0))
+                             zero1=(zero1 and i == 0),
+                             zero1_compile=zero1_compile)
             reps.append(rep)
             models_covered.update(rep["models"])
             status = "ok  " if rep["ok"] else "FAIL"
@@ -460,9 +566,15 @@ def run(names: list[str] | None = None, *,
             if "trace" in rep:
                 crashed_models.update({case.name, *case.models})
             failures += 0 if rep["ok"] else 1
-        for prob in mesh_consistency(reps):
-            print(f"     FAIL: {case.name}: {prob}")
-            failures += 1
+        # the mesh-generalization gate only holds for the replicated
+        # compile: under ZeRO-1 the partitioner re-plans the update's
+        # shard shuffle per grid (counts legitimately differ across
+        # meshes), so cross-mesh structure is not an invariant there —
+        # the per-(mesh, zero1) byte ledger gates those programs
+        if not zero1_compile:
+            for prob in mesh_consistency(reps):
+                print(f"     FAIL: {case.name}: {prob}")
+                failures += 1
         if zero1 and reps and "zero1" in reps[0]:
             zero1_rows.append((case.name, reps[0]["zero1"]))
     # stale-entry warnings: same burn-down contract as every ledger.
@@ -478,11 +590,29 @@ def run(names: list[str] | None = None, *,
                 print(f"warning: stale shardcheck.rule {r.pattern!r} "
                       "matched no state leaf of any registry model — "
                       "delete or fix the row", file=sys.stderr)
-    for w in scfg.reshard:
-        if w.hits == 0 and w.model in sel_models:
-            print(f"warning: stale shardcheck.reshard waiver "
-                  f"{w.model!r} {w.op!r} ({w.reason}) — nothing "
-                  "matched; delete the entry", file=sys.stderr)
+    stale_waivers = [w for w in scfg.reshard
+                     if w.hits == 0 and w.model in sel_models]
+    for w in stale_waivers:
+        print(f"warning: stale shardcheck.reshard waiver "
+              f"{w.model!r} {w.op!r} ({w.reason}) — nothing "
+              "matched; delete the entry", file=sys.stderr)
+    if prune_waivers and stale_waivers:
+        from tools.jaxlint.core import prune_blocks
+
+        # only waivers proven stale by THIS run's compiles are
+        # touched: staleness is judged per completed case, so a
+        # targeted `shardcheck <models> --prune-waivers --fix` burns
+        # down exactly what it just verified
+        _, removed = prune_blocks(
+            config, "shardcheck.reshard",
+            {(w.model, w.op, w.mesh) for w in stale_waivers},
+            lambda e: (e.get("model", ""), e.get("op", ""),
+                       str(e.get("mesh", "*"))),
+            fix=fix)
+        print(f"{'pruned' if fix else 'would prune'} {removed} stale "
+              f"[[shardcheck.reshard]] waiver"
+              f"{'s' if removed != 1 else ''}"
+              f"{'' if fix else ' (pass --fix to rewrite the config)'}")
     if record and to_record:
         print("\n# paste into jaxlint.toml (recorded comms baselines):")
         print("\n".join(to_record))
@@ -530,8 +660,25 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the per-model replicated-residency "
                              "worklist ZeRO-1 would shard (ROADMAP "
                              "item-1 twin of ircheck --bf16-ready)")
+    parser.add_argument("--zero1", action="store_true",
+                        help="compile under the engine's ZeRO-1 state "
+                             "specs and verify from the compiled "
+                             "output shardings that every prescribed "
+                             "opt-state leaf is stored sharded (the "
+                             "worklist-empty proof); comms baselines "
+                             "are keyed zero1 = true")
+    parser.add_argument("--prune-waivers", action="store_true",
+                        help="drop [[shardcheck.reshard]] waivers this "
+                             "run proves stale (compiled cases whose "
+                             "waived opcode never appeared) from the "
+                             "config; dry-run unless --fix")
+    parser.add_argument("--fix", action="store_true",
+                        help="with --prune-waivers: rewrite the config "
+                             "file in place")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
+    if args.fix and not args.prune_waivers:
+        parser.error("--fix only makes sense with --prune-waivers")
     meshes = ([s.strip() for s in args.mesh.split(",") if s.strip()]
               if args.mesh else None)
     try:
@@ -552,7 +699,9 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     return run(args.names or None, config=args.config, fast=args.fast,
                meshes=meshes, record=args.record,
-               zero1=args.zero1_ready, verbose=args.verbose)
+               zero1=args.zero1_ready, zero1_compile=args.zero1,
+               prune_waivers=args.prune_waivers, fix=args.fix,
+               verbose=args.verbose)
 
 
 if __name__ == "__main__":

@@ -77,10 +77,19 @@ def parse_args():
     p.add_argument("--check-numerics", action="store_true",
                    help="run the train step under checkify float checks "
                         "(NaN/Inf raise with the failing op; ~2x slower)")
-    p.add_argument("--shard-weight-update", action="store_true",
-                   help="ZeRO-1-style optimizer-state sharding over the "
-                        "data axis (arXiv:2004.13336); saves optimizer "
-                        "memory per chip, identical numerics")
+    p.add_argument("--zero1", "--shard-weight-update", dest="zero1",
+                   action="store_true", default=None,
+                   help="ZeRO-1 cross-replica weight-update sharding "
+                        "(arXiv:2004.13336): grads reduce-scattered, "
+                        "optimizer state sharded over the data axis, "
+                        "params all-gathered — per the "
+                        "[[shardcheck.rule]] table (core/sharding.py); "
+                        "frees ~(1-1/N) of optimizer memory per chip, "
+                        "numerics bit-comparable. train_dist.py turns "
+                        "this on by default on multi-host launches")
+    p.add_argument("--no-zero1", dest="zero1", action="store_false",
+                   help="force the replicated weight update (opt out of "
+                        "train_dist.py's multi-host ZeRO-1 default)")
     p.add_argument("--async-checkpoint", action="store_true",
                    help="overlap per-epoch Orbax saves with training "
                         "(save() returns after staging to host)")
@@ -588,7 +597,7 @@ def main():
         model, cfg, mesh, train_data, val_data,
         workdir=args.workdir, steps_per_epoch=steps,
         check_numerics=args.check_numerics,
-        shard_weight_update=args.shard_weight_update,
+        shard_weight_update=bool(args.zero1),
         async_checkpoint=args.async_checkpoint,
         keep_best=args.keep_best, data_echo=args.data_echo,
         prefetch_depth=args.prefetch_depth,
@@ -840,7 +849,7 @@ def run_gan(args, cfg, policy):
             resume=args.resume or args.checkpoint is not None,
             resume_epoch=args.checkpoint,
             check_numerics=args.check_numerics,
-            shard_weight_update=args.shard_weight_update,
+            shard_weight_update=bool(args.zero1),
             async_checkpoint=args.async_checkpoint,
             preempt=preempted,
             watchdog=watchdog,

@@ -46,7 +46,9 @@ joins the processes into one runtime; each process feeds only its own
 file shard (``make_dataset(num_process=, process_index=)``) through its
 own async device-feed thread; ``core.shard_batch`` assembles per-process
 local arrays into global jax.Arrays. Multi-host runs default to
-``--prefetch-depth 3``. Everything else — step functions, checkpointing
+``--prefetch-depth 3`` and to ZeRO-1 cross-replica weight-update
+sharding (``--zero1``; ``--no-zero1`` opts out — core/sharding.py).
+Everything else — step functions, checkpointing
 (Orbax is multi-process-aware), metrics — is identical to single-host
 train.py, which worker mode delegates to after initialization.
 """
@@ -243,6 +245,18 @@ def run_worker(dist_args, train_argv) -> None:
         # make_array_from_process_local_data assembly adds latency
         # jitter that a 2-deep queue lets through to the step
         train_argv += ["--prefetch-depth", "3"]
+    if jax.process_count() > 1 and not any(
+            a in ("--zero1", "--no-zero1", "--shard-weight-update")
+            for a in train_argv):
+        # ZeRO-1 default on multi-host: with >1 host the data axis is
+        # where the memory is — cross-replica weight-update sharding
+        # (arXiv:2004.13336) frees ~(1-1/N) of optimizer state per
+        # chip for a reduce-scatter/all-gather swap that is free-to-
+        # cheap on TPU ICI. --no-zero1 opts back into the replicated
+        # update.
+        train_argv += ["--zero1"]
+        print("[cluster] multi-host: ZeRO-1 weight-update sharding on "
+              "by default (--no-zero1 opts out)", flush=True)
 
     sys.argv = [sys.argv[0], *train_argv]
     import train
