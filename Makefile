@@ -136,6 +136,40 @@ serve-smoke:
 	    assert len(ok) == 3, rows; \
 	    print('serve-smoke OK (3/3 responses)')"
 
+# pipeline smoke: the device-resident DAG tier (serve/pipeline.py),
+# two legs. (1) a 2-stage toy DAG (resize glue -> lenet5) from a
+# generated --pipelines spec, served over the stdin-JSONL CLI alongside
+# plain model traffic — asserts 3/3 DAG + 2/2 plain responses and the
+# grep-stable `[pipeline]` exit line (served counts + frozen cache).
+# (2) the REAL detect->crop->pose DAG at reduced geometry
+# (tools/pipeline_smoke.py): decision parity vs the sequential client,
+# flat post-warm miss counter, per-stage spans merged and verified by
+# the trace_merge --assert-flow gate. Evidence log under logs/.
+pipeline-smoke:
+	@mkdir -p logs; L="logs/pipeline-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	$(PY) -c "import json; print(json.dumps({'name': 'lenetpipe', \
+	    'input': {'shape': [64, 64, 1]}, 'buckets': [1, 4], \
+	    'nodes': [ \
+	        {'name': 'shrink', 'glue': 'resize', 'params': {'size': 32}}, \
+	        {'name': 'cls', 'model': 'lenet5', 'inputs': ['shrink']}], \
+	    'outputs': ['cls']}))" > logs/pipeline-smoke-spec.json && \
+	$(PY) -c "import json, numpy as np; \
+	    [print(json.dumps({'id': i, 'pipeline': 'lenetpipe', \
+	     'input': np.zeros((64, 64, 1)).tolist()})) for i in range(3)]; \
+	    [print(json.dumps({'id': 10 + i, 'model': 'lenet5', \
+	     'input': np.zeros((32, 32, 1)).tolist()})) for i in range(2)]" \
+	| $(PY) serve.py -m lenet5 --buckets 1,4 \
+	    --pipelines logs/pipeline-smoke-spec.json 2> "$$L" \
+	| $(PY) -c "import sys, json; \
+	    rows = [json.loads(l) for l in sys.stdin if l.strip()]; \
+	    dag = [r for r in rows if 'result' in r and 'cls' in r['result']]; \
+	    plain = [r for r in rows if 'result' in r and 'classes' in r['result']]; \
+	    assert len(dag) == 3 and len(plain) == 2, rows; \
+	    print('pipeline-smoke stream OK (3 DAG + 2 plain responses)')" && \
+	grep -qE "\[pipeline\] served lenetpipe=3 frozen=True" "$$L" && \
+	$(PY) tools/pipeline_smoke.py 2>&1 | tee -a "$$L" && \
+	grep -q "pipeline-smoke OK" "$$L"
+
 # router smoke: boot a 2-replica lenet process fleet behind the router
 # (serve.py --fleet), stream 24 JSONL requests through it while the
 # chaos schedule SIGKILLs one replica at routed-request #5, and assert
@@ -335,7 +369,7 @@ threadcheck-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint lint-comms serve-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke zero1-smoke
+check: lint lint-comms serve-smoke pipeline-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke zero1-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -459,4 +493,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-threads lint-ir lint-comms bf16-ready precision-smoke zero1-smoke check serve-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-threads lint-ir lint-comms bf16-ready precision-smoke zero1-smoke check serve-smoke pipeline-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal

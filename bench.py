@@ -1443,6 +1443,137 @@ def serve_bench(n_requests: int = SERVE_REQUESTS) -> dict:
         engine.close()
 
 
+# ---- pipeline serving bench (`python bench.py pipeline`) ----------------
+# e2e detect -> crop -> pose through the device-resident DAG
+# (serve/pipeline.py) vs the two-sequential-/v1/predict client it
+# replaces: detect round-trip, HOST-side top-k + crop, then one pose
+# round-trip per crop. Interleaved A/B closed-loop pairs (alternating
+# order, same images) so scheduler/cache drift lands on both arms;
+# p50/p95 per arm + the speedup ratio in one JSON row. Real task heads
+# at reduced geometry (the tests/test_serve.py slow-tier pairing) so
+# the measured win is the serving path, not model FLOPs.
+PIPELINE_REQUESTS = int(os.environ.get("BENCH_PIPELINE_REQUESTS", "8"))
+PIPELINE_FANOUT_K = int(os.environ.get("BENCH_PIPELINE_K", "2"))
+PIPELINE_SIZE = 64  # yolov3/hourglass geometry AND the crop size
+
+
+def pipeline_bench() -> dict:
+    import contextlib
+
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.ops.crop_resize import crop_and_resize
+    from deepvision_tpu.serve import (
+        InferenceEngine,
+        Pipeline,
+        PipelineSpec,
+    )
+    from deepvision_tpu.serve.models import load_served
+
+    k, size = PIPELINE_FANOUT_K, PIPELINE_SIZE
+    # restore chatter to stderr: stdout is the one-JSON-line contract
+    with contextlib.redirect_stdout(sys.stderr):
+        detect = load_served("yolov3", None, task="detect",
+                             input_size=size, num_classes=5,
+                             score_thresh=0.0)
+        pose = load_served("hourglass104", None, task="pose",
+                           input_size=size, num_heatmaps=4)
+    spec = PipelineSpec.from_json({
+        "name": "detpose",
+        "buckets": [1, 4],
+        "nodes": [
+            {"name": "det", "model": "yolov3"},
+            {"name": "people", "glue": "top_k_boxes",
+             "inputs": ["det"], "params": {"k": k}},
+            {"name": "crop", "glue": "crop_resize",
+             "inputs": ["input", "people"], "params": {"size": size}},
+            {"name": "pose", "model": "hourglass104",
+             "inputs": ["crop.crops"], "buckets": [k, 4 * k]},
+        ],
+        "outputs": [{"node": "det"},
+                    {"node": "pose", "mask": "crop.valid"}],
+    })
+    pipe = Pipeline(spec, {"yolov3": detect, "hourglass104": pose})
+    engine = InferenceEngine(
+        [detect, pose], mesh=create_mesh(1, 1), buckets=(1, 4),
+        pipelines=[pipe], freeze_cache=True,
+    )
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(PIPELINE_REQUESTS, size, size, 3)).astype(
+        np.float32)
+
+    def run_dag(x):
+        return engine.submit(x, model="detpose").result(timeout=600)
+
+    def run_sequential(x):
+        # the client the DAG replaces: fetch the detect answer, glue on
+        # the host, re-submit one predict per crop
+        det = engine.submit(x, model="yolov3").result(timeout=600)
+        scores = np.asarray(det["scores"], np.float32)
+        boxes = np.asarray(det["boxes"], np.float32).reshape(-1, 4)
+        order = (np.argsort(-scores, kind="stable")[:k]
+                 if scores.size else [])
+        sel = np.zeros((k, 4), np.float32)
+        for slot, idx in enumerate(order):
+            sel[slot] = boxes[idx]
+        crops = np.asarray(crop_and_resize(x[None], sel[None], size))[0]
+        poses = [engine.submit(c, model="hourglass104").result(
+            timeout=600) for c in crops]
+        return det, poses
+
+    try:
+        # pace both arms past first-dispatch jitter (every executable
+        # compiled in the constructor — the cache is frozen)
+        run_dag(xs[0])
+        run_sequential(xs[0])
+        misses_warm = engine.stats()["cache"]["misses"]
+        lat = {"pipeline": [], "sequential": []}
+        for i in range(PIPELINE_REQUESTS):
+            arms = [("pipeline", run_dag),
+                    ("sequential", run_sequential)]
+            if i % 2:
+                arms.reverse()
+            for label, fn in arms:
+                t0 = time.perf_counter()
+                fn(xs[i])
+                lat[label].append(time.perf_counter() - t0)
+
+        def pcts(vals):
+            v = np.sort(np.asarray(vals))
+            return {"p50": round(float(np.percentile(v, 50)) * 1e3, 1),
+                    "p95": round(float(np.percentile(v, 95)) * 1e3, 1),
+                    "mean": round(float(v.mean()) * 1e3, 1)}
+
+        pipe_ms, seq_ms = pcts(lat["pipeline"]), pcts(lat["sequential"])
+        stats = engine.stats()
+        return {
+            "metric": "pipeline_detpose_sequential_over_dag_p50",
+            "value": round(seq_ms["p50"] / pipe_ms["p50"], 2),
+            "unit": "x (sequential / pipeline e2e latency, p50)",
+            "requests_per_arm": PIPELINE_REQUESTS,
+            "fanout_k": k,
+            "input_size": size,
+            "pipeline_e2e_ms": pipe_ms,
+            "sequential_e2e_ms": seq_ms,
+            "speedup_p95": round(seq_ms["p95"] / pipe_ms["p95"], 2),
+            # acceptance tripwire: frozen cache + flat misses = zero
+            # request-time compiles on either arm
+            "no_retrace_after_warmup": (
+                stats["cache"]["misses"] == misses_warm),
+            "cache": stats["cache"],
+            "pipelines_served": stats["pipelines"],
+            "warmup_s": stats["warmup_s"],
+            # CPU row caveat: on this box the DAG's win is host-hop
+            # elimination (one submit/fetch/decode instead of 1+k); on
+            # TPU the device-resident edges additionally skip the
+            # PCIe/H2D round-trip per hop, so treat this number as the
+            # floor of the production speedup
+            "device_kind": jax.devices()[0].device_kind,
+            "obs": _obs_snapshot(),
+        }
+    finally:
+        engine.close()
+
+
 # ---- serving fleet sweep (`python bench.py serve --sweep`) --------------
 # Latency-throughput curve + replica-scaling ratio + SIGKILL chaos drill
 # for the fleet router (deepvision_tpu/serve/router.py). Three sections:
@@ -1937,6 +2068,8 @@ if __name__ == "__main__":
                     _flags + " --xla_force_host_platform_device_count=8"
                 ).strip()
             print(json.dumps(zero1_bench()))
+        elif "pipeline" in sys.argv[1:]:
+            print(json.dumps(pipeline_bench()))
         elif "serve" in sys.argv[1:]:
             if "--sweep" in sys.argv[1:]:
                 print(json.dumps(serve_sweep_bench()))

@@ -43,18 +43,22 @@ def save_exported(path: str | Path, data: bytes) -> Path:
 def load_exported(path: str | Path):
     """-> callable(x) running the deserialized computation.
 
-    The callable carries the artifact's input signature as metadata —
-    ``.in_avals`` (the ``ShapeDtypeStruct`` tuple the forward was
-    lowered at) and ``.exported`` (the raw ``jax.export.Exported``) —
-    because a StableHLO artifact is shape-specialized: a serving host
-    (``serve.models.from_stablehlo``) must know the exported batch size
-    to pin its bucket ladder, and a caller feeding the wrong shape
-    should find out from the spec, not a runtime shape error."""
+    The callable carries the artifact's FULL signature as metadata —
+    ``.in_avals`` / ``.out_avals`` (the ``ShapeDtypeStruct`` tuples the
+    forward was lowered at) and ``.exported`` (the raw
+    ``jax.export.Exported``) — because a StableHLO artifact is
+    shape-specialized: a serving host (``serve.models.from_stablehlo``)
+    must know the exported batch size to pin its bucket ladder, a
+    pipeline validator (``serve.pipeline``) must know the output
+    shapes/dtypes to type-check a DAG edge BEFORE any compile, and a
+    caller feeding the wrong shape should find out from the spec, not
+    a runtime shape error."""
     exported = jax_export.deserialize(Path(path).read_bytes())
 
     def call(*args):
         return exported.call(*args)
 
     call.in_avals = exported.in_avals
+    call.out_avals = exported.out_avals
     call.exported = exported
     return call

@@ -1,0 +1,76 @@
+"""Device-resident crop/resize primitives for pipeline glue stages.
+
+The serving DAG (``serve/pipeline.py``) composes models whose
+geometries differ — detect at one input size, pose at another, with a
+box-conditioned crop in between. The reference implementations do this
+hop on the host (PIL crops between two model invocations); here every
+primitive is pure fixed-shape ``jnp`` so the glue compiles into the
+pipeline's device program and intermediate tensors never leave HBM:
+
+- :func:`crop_and_resize` — batched box-conditioned bilinear crops
+  (the ``tf.image.crop_and_resize`` analog): ``(B,H,W,C)`` images +
+  ``(B,K,4)`` normalized corner boxes -> ``(B,K,S,S,C)`` crops, via
+  per-box sampling grids and four-corner gathers. Degenerate boxes
+  (the zero rows NMS padding produces) sample a clipped constant patch
+  — garbage rows are masked by the caller's ``valid`` plane, exactly
+  the engine's pad-isolation contract.
+- :func:`resize_bilinear` — whole-image resize to a stage's input
+  geometry (``jax.image.resize``, fixed output shape).
+
+Everything here is shape-static: ``K`` and ``S`` are compile-time
+constants, so ragged "people found per frame" traffic still hits one
+executable per (stage, bucket) — raggedness lives in the mask, never
+in the shapes (the compile-once discipline jaxlint JX105/JX110 pins).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["crop_and_resize", "resize_bilinear"]
+
+
+def _crop_one(img: jnp.ndarray, box: jnp.ndarray, size: int) -> jnp.ndarray:
+    """One ``(H,W,C)`` image x one normalized corner box -> ``(S,S,C)``
+    bilinear crop. Sample points are the S pixel centers spanning the
+    box; each samples the image with a 4-corner bilinear gather
+    (edge-clamped, matching ``jax.image.resize``'s edge handling)."""
+    h, w = img.shape[0], img.shape[1]
+    # clamp to the image so junk NMS corners (saturated heads emit
+    # +/-inf) can't poison the sample grid with NaN (0 * inf)
+    box = jnp.clip(box, 0.0, 1.0)
+    x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+    # pixel-center sample coordinates in source-pixel space
+    frac = (jnp.arange(size, dtype=jnp.float32) + 0.5) / size
+    fy = (y1 + (y2 - y1) * frac) * h - 0.5
+    fx = (x1 + (x2 - x1) * frac) * w - 0.5
+    y0 = jnp.floor(fy)
+    x0 = jnp.floor(fx)
+    wy = (fy - y0)[:, None, None]
+    wx = (fx - x0)[None, :, None]
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+    y1i = jnp.clip(y0i + 1, 0, h - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+    x1i = jnp.clip(x0i + 1, 0, w - 1)
+    # gather rows then columns: (S,W,C) -> (S,S,C) per corner
+    top = img[y0i][:, x0i] * (1 - wx) + img[y0i][:, x1i] * wx
+    bot = img[y1i][:, x0i] * (1 - wx) + img[y1i][:, x1i] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def crop_and_resize(images: jnp.ndarray, boxes: jnp.ndarray,
+                    size: int) -> jnp.ndarray:
+    """``(B,H,W,C)`` images + ``(B,K,4)`` normalized ``(x1,y1,x2,y2)``
+    corner boxes -> ``(B,K,S,S,C)`` bilinear crops (float32)."""
+    images = jnp.asarray(images, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    per_image = jax.vmap(_crop_one, in_axes=(None, 0, None))
+    return jax.vmap(per_image, in_axes=(0, 0, None))(images, boxes, size)
+
+
+def resize_bilinear(images: jnp.ndarray, size: int) -> jnp.ndarray:
+    """``(B,H,W,C)`` -> ``(B,size,size,C)`` bilinear resize (float32)."""
+    images = jnp.asarray(images, jnp.float32)
+    b, _, _, c = images.shape
+    return jax.image.resize(images, (b, size, size, c), method="bilinear")

@@ -17,6 +17,13 @@ The serving runtime layer (ROADMAP north star: "serves heavy traffic"):
                       path (classify/detect/pose/gan) shared by
                       ``predict.py`` and the server; also wraps
                       StableHLO artifacts from ``export.py``.
+- ``pipeline``      : device-resident DAGs of compiled stages — a
+                      declarative spec (nodes/edges, validated acyclic +
+                      aval-compatible before any compile) served through
+                      the same queue/bucket/cache path as a model, with
+                      jitted glue (top-K boxes, crop+resize, resize) and
+                      ragged fan-out chunked per-stage; stage outputs
+                      never touch the host until the final decode.
 - ``admission``     : queue-depth backpressure, per-model limits,
                       SLO-aware deadline budgets, and
                       reject-with-retry-after shedding.
@@ -46,6 +53,13 @@ from deepvision_tpu.serve.models import (
     load_served,
     restore_state,
 )
+from deepvision_tpu.serve.pipeline import (
+    ModelStage,
+    Pipeline,
+    PipelineError,
+    PipelineSpec,
+    load_pipeline_specs,
+)
 from deepvision_tpu.serve.replica import (
     EngineReplica,
     ProcessReplica,
@@ -71,6 +85,11 @@ __all__ = [
     "CompileCache",
     "InferenceEngine",
     "ServedModel",
+    "ModelStage",
+    "Pipeline",
+    "PipelineError",
+    "PipelineSpec",
+    "load_pipeline_specs",
     "from_stablehlo",
     "load_served",
     "restore_state",

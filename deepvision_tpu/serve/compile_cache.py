@@ -41,10 +41,24 @@ class CompileCache:
             raise ValueError(f"max_entries must be >= 1, got {max_entries}")
         self.max_entries = max_entries
         self._entries: OrderedDict[Hashable, Callable] = OrderedDict()
-        self._lock = threading.Lock()
+        # reentrant: a pipeline's whole-DAG builder runs under the lock
+        # and compiles its per-stage executables through this same
+        # cache (nested get_or_build from the same thread)
+        self._lock = threading.RLock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self._frozen = False
+
+    def freeze(self) -> None:
+        """Turn "misses must stay frozen after warmup" from a telemetry
+        tripwire into a hard guarantee: any later miss raises instead of
+        compiling. The engine calls this after an end-to-end ``warm()``
+        (``freeze_cache=True``) — a pipeline request hitting a cold
+        (stage, bucket, dtype) key is a warmup-coverage bug, and paying
+        the trace silently would hide it as tail latency."""
+        with self._lock:
+            self._frozen = True
 
     def get_or_build(self, key: Hashable, build: Callable[[], Callable]):
         with self._lock:
@@ -52,6 +66,11 @@ class CompileCache:
                 self.hits += 1
                 self._entries.move_to_end(key)
                 return self._entries[key]
+            if self._frozen:
+                raise RuntimeError(
+                    f"compile cache is frozen after warmup but key "
+                    f"{key!r} missed — a request would have paid a "
+                    "hidden trace/compile (warmup coverage bug)")
             self.misses += 1
             runner = build()
             self._entries[key] = runner
@@ -75,4 +94,5 @@ class CompileCache:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                "frozen": self._frozen,
             }

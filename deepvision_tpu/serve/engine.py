@@ -94,6 +94,16 @@ class InferenceEngine:
     long the dispatcher waits for the bucket to fill before running a
     partial (padded) batch. 0 trades padding for latency; saturation
     traffic fills buckets regardless via the backlog.
+
+    ``pipelines``: built :class:`~deepvision_tpu.serve.pipeline.Pipeline`
+    DAGs to serve beside the models. Each binds to the engine's shared
+    compile cache + mesh and then rides the SAME queue/bucket/admission
+    path as a model — ``submit(x, model=<pipeline name>)`` just works,
+    and ``warm()`` compiles every stage of every pipeline end-to-end.
+
+    ``freeze_cache``: freeze the compile cache after warmup — any
+    request-time miss raises instead of tracing, proving no request
+    (pipeline or plain) can ever pay a hidden compile.
     """
 
     def __init__(
@@ -111,6 +121,8 @@ class InferenceEngine:
         fault_injector=None,
         restart_backoff_s: float = 0.05,
         restart_backoff_max_s: float = 5.0,
+        pipelines: Iterable = (),
+        freeze_cache: bool = False,
     ):
         if isinstance(models, dict):
             self._models = dict(models)
@@ -128,10 +140,18 @@ class InferenceEngine:
             # host; pass an explicit mesh to shard batches over chips
         self._mesh = mesh
         self.buckets = tuple(buckets)
+        self._cache = CompileCache(max_entries=cache_entries)
+        for p in pipelines:
+            if p.name in self._models:
+                raise ValueError(
+                    f"pipeline {p.name!r} collides with a served model")
+            # bind before _check_ladders: divisibility is checked for
+            # every STAGE ladder, not just the pipeline's entry ladder
+            p.bind(self._cache, self._mesh, self.buckets)
+            self._models[p.name] = p
         self._check_ladders()
         self.telemetry = telemetry if telemetry is not None \
             else ServeTelemetry()
-        self._cache = CompileCache(max_entries=cache_entries)
         self._admission = AdmissionController(
             max_queue=max_queue, per_model_limit=per_model_limit)
         self._window = batch_window_s
@@ -161,6 +181,10 @@ class InferenceEngine:
         self._replicate_variables()
         if warmup:
             self.warm()
+            if freeze_cache:
+                # warmed end-to-end (pipelines included): any later
+                # miss is a hidden request-time compile — fail loudly
+                self._cache.freeze()
         self._thread = threading.Thread(
             target=self._supervise, name="serve-dispatch", daemon=True
         )
@@ -187,7 +211,16 @@ class InferenceEngine:
         from deepvision_tpu.core.mesh import replicated_sharding
 
         sharding = replicated_sharding(self._mesh)
+        targets = []
         for m in self._models.values():
+            if getattr(m, "is_pipeline", False):
+                # a pipeline's own variables are None; its STAGE models
+                # carry the weights (shared objects with the plain
+                # serving path when a model is served both ways)
+                targets.extend(m.stage_models().values())
+            else:
+                targets.append(m)
+        for m in targets:
             if m.variables is not None:
                 m.variables = jax.device_put(m.variables, sharding)
 
@@ -220,7 +253,13 @@ class InferenceEngine:
                     lambda m=m, bucket=bucket: m.compile_for(
                         bucket, self._mesh),
                 )
-                if m.precompiled is not None:
+                if m.precompiled is not None \
+                        or getattr(m, "is_pipeline", False):
+                    # pipelines zero-execute too: their runners thread
+                    # eager device ops (chunk slice/pad/concat, dict
+                    # re-packing) between stage executables, and any
+                    # StableHLO stage backend-compiles on first call —
+                    # one warm pass covers the whole DAG
                     x = np.zeros((bucket, *m.input_shape), m.input_dtype)
                     xd = jax.device_put(
                         x, data_sharding(self._mesh, x.ndim))
@@ -284,6 +323,10 @@ class InferenceEngine:
         """JSON-able state for ``/stats`` and the bench report."""
         return {
             "models": sorted(self._models),
+            "pipelines": {
+                name: m.requests_served
+                for name, m in sorted(self._models.items())
+                if getattr(m, "is_pipeline", False)},
             "buckets": list(self.buckets),
             "warmup_s": self.warmup_s,
             "health": self.health(),
@@ -528,6 +571,27 @@ class InferenceEngine:
             return
         self.telemetry.record_batch(bucket=bucket, rows=n, device_s=t_dev)
         self._admission.observe_batch(t_dev, n)
+        is_pipeline = getattr(served, "is_pipeline", False)
+        expired: set[int] = set()
+        if is_pipeline:
+            served.record_served(n)
+            # deadline honesty holds mid-DAG too: a multi-stage run can
+            # outlive a request's deadline after queue-time expiry
+            # passed it — resolve TimeoutError (exactly once; the
+            # try/except is the same releaser rule as _expire), never a
+            # late answer
+            t_now = time.perf_counter()
+            for r in reqs:
+                if r.deadline is not None and t_now > r.deadline:
+                    try:
+                        r.future.set_exception(TimeoutError(
+                            f"deadline expired mid-pipeline after "
+                            f"{t_now - r.t_submit:.3f}s"))
+                    except InvalidStateError:
+                        continue
+                    self.telemetry.record_timeout()
+                    self._admission.release(r.model)
+                    expired.add(id(r))
         tracer = get_tracer()
         if tracer.active:
             # retroactive spans from the stamps this loop already takes
@@ -541,6 +605,18 @@ class InferenceEngine:
                 "device", t0, t0 + t_dev, cat="serve",
                 args={"model": served.name, "bucket": bucket, "rows": n,
                       **({"traces": traces} if traces else {})})
+            if is_pipeline:
+                # one span per DAG stage, stamped with every request
+                # trace id in the batch: the trace ids flow router ->
+                # replica_queue -> device -> stage:<node> -> postprocess
+                # in a single Perfetto timeline (trace_merge
+                # --assert-flow proves the crossing)
+                for stage_name, s0, s1 in served.take_stage_stamps():
+                    tracer.record_span(
+                        f"stage:{stage_name}", s0, s1, cat="serve",
+                        args={"pipeline": served.name,
+                              "stage": stage_name,
+                              **({"traces": traces} if traces else {})})
             for r in reqs:
                 if r.trace:
                     tracer.record_span(
@@ -549,6 +625,8 @@ class InferenceEngine:
                         args={"trace": r.trace, "model": served.name})
         now = time.perf_counter()
         for i, r in enumerate(reqs):
+            if id(r) in expired:
+                continue  # resolved TimeoutError above, slot released
             t_pp = time.perf_counter()
             try:
                 result = served.postprocess(host, i)

@@ -156,47 +156,37 @@ class ServedModel:
         return str(np.dtype(self.input_dtype))
 
     # -- engine path -----------------------------------------------------
+    def as_stage(self):
+        """The compiled unit behind this model: a ``pipeline.ModelStage``
+        carrying the pure forward + variables + explicit input/output
+        avals — what a serving DAG composes. ``compile_for`` delegates
+        here so the single-model and pipeline paths share one AOT
+        compile recipe."""
+        from deepvision_tpu.serve.pipeline import ModelStage
+
+        return ModelStage(
+            name=self.name, forward=self.forward,
+            variables=self.variables, input_shape=self.input_shape,
+            input_dtype=self.input_dtype, precompiled=self.precompiled,
+            pinned_buckets=self.buckets,
+        )
+
+    def in_avals(self, bucket: int):
+        return self.as_stage().in_avals(bucket)
+
+    def out_avals(self, bucket: int):
+        """Abstract output pytree at ``bucket`` (``jax.eval_shape``, no
+        compile) — the seam a pipeline validator type-checks DAG edges
+        against, mirroring ``export.py``'s artifact metadata."""
+        return self.as_stage().out_avals(bucket)
+
     def compile_for(self, bucket: int, mesh) -> Callable:
         """AOT-compile the forward at ``(bucket, *input_shape)`` over
         ``mesh`` — batch sharded on the data axis, variables replicated,
         the input buffer donated — and return a runner
         ``x_device -> device outputs``. StableHLO-backed models return
         their deserialized executable (already compiled, one shape)."""
-        import jax
-
-        from deepvision_tpu.core.mesh import (
-            data_sharding,
-            replicated_sharding,
-        )
-
-        if self.precompiled is not None:
-            if self.buckets and bucket not in self.buckets:
-                raise ValueError(
-                    f"{self.name}: exported artifact is pinned to batch "
-                    f"{self.buckets}, cannot serve bucket {bucket}")
-            return self.precompiled
-        x_spec = jax.ShapeDtypeStruct(
-            (bucket, *self.input_shape), self.input_dtype)
-        fn = jax.jit(
-            self.forward,
-            in_shardings=(replicated_sharding(mesh),
-                          data_sharding(mesh, 1 + len(self.input_shape))),
-            donate_argnums=(1,),
-        )
-        import warnings
-
-        with warnings.catch_warnings():
-            # CPU backends can't honor input donation; the donate is a
-            # real HBM saving on TPU and a no-op warning elsewhere
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable")
-            compiled = fn.lower(self.variables, x_spec).compile()
-        variables = self.variables
-
-        def runner(x_device):
-            return compiled(variables, x_device)
-
-        return runner
+        return self.as_stage().compile(bucket, mesh, donate=True)
 
     # -- direct (engine-less) path: the one-shot CLI ---------------------
     def run(self, batch) -> Any:

@@ -80,9 +80,24 @@ def build_engine(args):
         sys.exit("no models: pass -m NAME[=WORKDIR] or --artifact")
     buckets = tuple(int(b) for b in args.buckets.split(","))
     mesh, buckets = _serving_mesh(buckets)
-    print(f"serving {[m.name for m in models]} buckets={buckets} "
-          f"on {mesh.devices.size} device(s); compiling...",
-          file=sys.stderr)
+    pipelines = []
+    if getattr(args, "pipelines", None):
+        from deepvision_tpu.serve.pipeline import (
+            Pipeline,
+            load_pipeline_specs,
+        )
+
+        by_name = {m.name: m for m in models}
+        for path in args.pipelines:
+            for spec in load_pipeline_specs(path):
+                # validates structure + every DAG edge's avals here,
+                # before any compile — a bad spec kills startup, not a
+                # request
+                pipelines.append(Pipeline(spec, by_name))
+    print(f"serving {[m.name for m in models]}"
+          f"{' pipelines ' + str([p.name for p in pipelines]) if pipelines else ''}"
+          f" buckets={buckets} on {mesh.devices.size} device(s); "
+          "compiling...", file=sys.stderr)
     injector = None
     if args.faults:
         from deepvision_tpu.resilience import FaultInjector
@@ -94,6 +109,11 @@ def build_engine(args):
         per_model_limit=args.per_model_limit,
         batch_window_s=args.batch_window_ms / 1e3,
         fault_injector=injector,
+        pipelines=pipelines,
+        # pipelines warm end-to-end, so the cache can be FROZEN: any
+        # later miss (a hidden request-time compile) raises instead of
+        # silently costing tail latency
+        freeze_cache=bool(pipelines),
     )
     print(f"warmup done in {engine.warmup_s}s "
           f"({engine.stats()['cache']['entries']} executables)",
@@ -143,6 +163,8 @@ def build_fleet(args):
            "--max-queue", str(args.max_queue),
            "--batch-window-ms", str(args.batch_window_ms),
            "--timeout-s", str(args.timeout_s)]
+        + [a for path in (args.pipelines or [])
+           for a in ("--pipelines", path)]
         + (["--trace-spool", args.trace_spool]
            if args.trace_spool else []))
 
@@ -171,6 +193,14 @@ def build_fleet(args):
         autoscale = AutoscaleConfig(min_replicas=args.fleet,
                                     max_replicas=fleet_max)
     models = [(_parse_spec(s)[0]) for s in args.model or []]
+    if args.pipelines:
+        # pipeline NAMES are routable like models; spec parsing is pure
+        # json (the router process never imports jax — each replica
+        # builds/validates/warms its own DAGs)
+        from deepvision_tpu.serve.pipeline import load_pipeline_specs
+
+        models += [spec.name for path in args.pipelines
+                   for spec in load_pipeline_specs(path)]
     print(f"starting fleet of {args.fleet} replica(s) "
           f"({models or args.artifact}); replicas compile in "
           "parallel...", file=sys.stderr)
@@ -280,7 +310,10 @@ def run_stdin(engine, args, stdin=None, stdout=None):
         rid = req.get("id")
         t0 = time.perf_counter()
         try:
-            fut = engine.submit(x, model=req.get("model"),
+            # a pipeline is addressed like a model ({"pipeline": name}
+            # is sugar for {"model": name}) — same queue, same engine
+            fut = engine.submit(x, model=(req.get("model")
+                                          or req.get("pipeline")),
                                 timeout_s=args.timeout_s,
                                 trace=req.get("trace"))
         except ShedError as e:
@@ -393,7 +426,16 @@ def make_handler(engine, args):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path not in ("/v1/predict", "/predict"):
+            # POST /v1/pipeline/<name> addresses a served DAG by URL;
+            # the engine serves pipelines through the same submit path
+            # as models, so past this point the request is ordinary
+            pipeline = None
+            if self.path.startswith("/v1/pipeline/"):
+                pipeline = self.path[len("/v1/pipeline/"):]
+                if not pipeline:
+                    self._send(404, {"error": "not found"})
+                    return
+            elif self.path not in ("/v1/predict", "/predict"):
                 self._send(404, {"error": "not found"})
                 return
             try:
@@ -421,8 +463,11 @@ def make_handler(engine, args):
             # request across processes
             trace = self.headers.get(TRACE_HEADER) or req.get("trace")
             try:
-                fut = engine.submit(x, model=req.get("model"),
-                                    timeout_s=timeout_s, trace=trace)
+                fut = engine.submit(
+                    x,
+                    model=(pipeline or req.get("model")
+                           or req.get("pipeline")),
+                    timeout_s=timeout_s, trace=trace)
                 result = fut.result(timeout=timeout_s + 1.0)
             except ShedError as e:
                 self._send(429, {"error": str(e),
@@ -532,6 +577,13 @@ def main(argv=None):
                    help="NAME[=WORKDIR], repeatable (multi-model host)")
     p.add_argument("--artifact", action="append",
                    help="[NAME=]PATH to a StableHLO export, repeatable")
+    p.add_argument("--pipelines", action="append", metavar="FILE",
+                   help="JSON pipeline spec file (one spec, a list, or "
+                        "{'pipelines': [...]}), repeatable; each DAG is "
+                        "validated (acyclic, aval-compatible, ladder-"
+                        "divisible) and warmed end-to-end at startup, "
+                        "then served via {'pipeline': NAME} on the "
+                        "JSONL surface or POST /v1/pipeline/NAME")
     p.add_argument("--http", type=int, default=None,
                    help="HTTP port (default: stdin-JSONL mode); 0 binds "
                         "an ephemeral port (see --port-file)")
@@ -628,6 +680,17 @@ def main(argv=None):
         engine.close()
         if spool is not None:
             spool.close()
+        stats = engine.stats()
+        if stats.get("pipelines"):
+            # grep-stable exit line: the pipeline smoke gate asserts
+            # served counts and that the frozen cache saw zero
+            # post-warm misses (no request paid a hidden compile)
+            served = ",".join(f"{k}={v}" for k, v in
+                              sorted(stats["pipelines"].items()))
+            cache = stats["cache"]
+            print(f"[pipeline] served {served} "
+                  f"frozen={cache['frozen']} misses={cache['misses']} "
+                  f"hits={cache['hits']}", file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

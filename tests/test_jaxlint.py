@@ -1956,6 +1956,124 @@ def test_load_config_reads_precision_funcs(tmp_path):
     assert "__call__" in LintConfig().precision_funcs
 
 
+# ----------------------------------------------------------- JX127
+
+
+def test_jx127_flags_host_fetch_in_pipeline_path(tmp_path):
+    r = lint(tmp_path, "serve/run.py", """
+        import jax
+        import numpy as np
+
+        def run_pipeline(stages, x):
+            for stage in stages:
+                x = stage(x)
+                x = jax.device_get(x)       # host hop: flagged
+            host = np.asarray(x)            # flagged
+            x.block_until_ready()           # flagged
+            return host
+        """)
+    assert codes(r) == ["JX127", "JX127", "JX127"]
+    assert "device-resident" in r.findings[0].message
+
+
+def test_jx127_flags_helper_routed_sync(tmp_path):
+    # the sync hides inside a helper the pipeline path calls — the
+    # project blocking-callable summary routes the finding through
+    r = lint(tmp_path, "serve/run.py", """
+        import numpy as np
+
+        def _to_host(v):
+            return np.asarray(v)
+
+        def run_pipeline(stages, x):
+            for stage in stages:
+                x = _to_host(stage(x))
+            return x
+        """)
+    assert codes(r) == ["JX127"]
+    assert "_to_host" in r.findings[0].message
+
+
+def test_jx127_passes_device_resident_path(tmp_path):
+    # clean DAG runner: values flow stage to stage as device arrays;
+    # the fetch lives in a non-pipeline function (the engine's single
+    # final device_get + host postprocess)
+    r = lint(tmp_path, "serve/run.py", """
+        import jax
+
+        def run_pipeline(stages, x):
+            env = {"input": x}
+            for name, stage in stages:
+                env[name] = stage(env["input"])
+            return env
+
+        def decode(outputs):
+            return jax.device_get(outputs)
+        """)
+    assert codes(r) == []
+
+
+def test_jx127_nested_def_not_charged_to_parent(tmp_path):
+    # the sync sits in a nested non-matching closure (a postprocess
+    # callback built by the pipeline factory) — own-body scoping must
+    # not charge the matching parent for it
+    r = lint(tmp_path, "serve/run.py", """
+        import numpy as np
+
+        def build_pipeline(stages):
+            def decode_row(host, i):
+                return np.asarray(host[i]).tolist()
+            return stages, decode_row
+        """)
+    assert codes(r) == []
+
+
+def test_jx127_pipeline_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(pipeline_funcs=["execute_graph*"])
+    r = lint(tmp_path, "lib/graph.py", """
+        import jax
+
+        def execute_graph(stages, x):
+            for s in stages:
+                x = jax.device_get(s(x))    # matched by the knob
+            return x
+
+        def run_pipeline(stages, x):
+            for s in stages:
+                x = jax.device_get(s(x))    # default name NOT matched
+            return x
+        """, cfg=cfg)
+    assert codes(r) == ["JX127"]
+
+
+def test_jx127_inline_suppression(tmp_path):
+    # the repo's own traced-mode span sync uses exactly this pragma
+    r = lint(tmp_path, "serve/run.py", """
+        import jax
+
+        def run_pipeline(stages, x, traced):
+            for s in stages:
+                x = s(x)
+                if traced:
+                    x = jax.block_until_ready(x)  # jaxlint: disable=JX127
+            return x
+        """)
+    assert codes(r) == []
+
+
+def test_load_config_reads_pipeline_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        pipeline_funcs = ["execute_graph*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.pipeline_funcs == ["execute_graph*"]
+    assert "*pipeline*" in LintConfig().pipeline_funcs
+
+
 # ------------------------------- concurrency tier (ISSUE 14, JX118-122)
 
 

@@ -1,0 +1,502 @@
+"""Pipeline serving (deepvision_tpu/serve/pipeline.py): spec validation
+(cycle / aval-mismatch / bad-ladder rejection — all BEFORE any compile),
+ragged fan-out chunk accounting, decision parity vs the sequential
+two-call baseline (the PR 3 cross-bucket tolerance contract per task
+head), mid-DAG deadline expiry failing the request exactly once, clean
+shutdown with no leaked threads, cross-stage trace flow asserted via
+``tools/trace_merge.py --assert-flow``, and the ``export.py``
+``.out_avals`` StableHLO round-trip the DAG validator consumes.
+
+Fixtures mirror tests/test_serve.py: toy forwards that compile in
+milliseconds so the whole file stays in the fast tier. The canonical
+DAG is the ISSUE's motivating workload — detect -> top-K person boxes
+-> crop -> pose micro-batch — at 16x16 images so every stage is cheap.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from deepvision_tpu.serve.pipeline import (  # noqa: E402
+    Pipeline,
+    PipelineError,
+    PipelineSpec,
+    chunk_plan,
+    load_pipeline_specs,
+)
+
+# ------------------------------------------------------------- fixtures
+
+
+def toy_detector(name="det", weight=1.0):
+    """Detect-head toy: 3 fixed boxes per image scored 0.9/0.6/0.1 with
+    a tiny input-dependent wobble, so cross-bucket parity is a real
+    numeric check, not a constant-folding artifact."""
+    import jax.numpy as jnp
+
+    from deepvision_tpu.serve import ServedModel
+    from deepvision_tpu.serve.models import _detect_post
+
+    def forward(variables, x):
+        b = x.shape[0]
+        base = jnp.tanh(jnp.mean(x, axis=(1, 2, 3))) * 1e-3  # (B,)
+        boxes = jnp.tile(jnp.array([[0.1, 0.1, 0.5, 0.5],
+                                    [0.4, 0.4, 0.9, 0.9],
+                                    [0.0, 0.0, 1.0, 1.0]], jnp.float32),
+                         (b, 1, 1))
+        scores = jnp.stack([base + 0.9, base + 0.6, base + 0.1], axis=1)
+        return {"boxes": boxes * variables["w"], "scores": scores,
+                "classes": jnp.zeros((b, 3), jnp.int32),
+                "valid": scores > 0.5}
+
+    return ServedModel(
+        name=name, task="detect", forward=forward,
+        variables={"w": np.float32(weight)}, input_shape=(16, 16, 3),
+        postprocess=_detect_post)
+
+
+def toy_pose(name="pose"):
+    """Pose-head toy over 8x8 crops: joints derived from the crop mean,
+    so a wrong crop (or a padded row leaking through) changes the
+    answer."""
+    import jax.numpy as jnp
+
+    from deepvision_tpu.serve import ServedModel
+    from deepvision_tpu.serve.models import _pose_post
+
+    def forward(variables, x):
+        m = jnp.mean(x, axis=(1, 2, 3))
+        kx = jnp.stack([m, m * 2], axis=1)
+        return {"x": kx, "y": kx + 1, "conf": kx * 0 + 0.8}
+
+    return ServedModel(
+        name=name, task="pose", forward=forward,
+        variables={"w": np.float32(1.0)}, input_shape=(8, 8, 3),
+        postprocess=_pose_post)
+
+
+def detpose_json(k=2, size=8, pose_buckets=(1, 2, 8)):
+    return {
+        "name": "detpose",
+        "buckets": [1, 4],
+        "nodes": [
+            {"name": "detect", "model": "det"},
+            {"name": "people", "glue": "top_k_boxes",
+             "inputs": ["detect"], "params": {"k": k}},
+            {"name": "crop", "glue": "crop_resize",
+             "inputs": ["input", "people"], "params": {"size": size}},
+            {"name": "posestage", "model": "pose",
+             "inputs": ["crop.crops"], "buckets": list(pose_buckets)},
+        ],
+        "outputs": [{"node": "detect"},
+                    {"node": "posestage", "mask": "crop.valid"}],
+    }
+
+
+def detpose_pipeline(**kw):
+    det, pose = toy_detector(), toy_pose()
+    spec = PipelineSpec.from_json(detpose_json(**kw))
+    return Pipeline(spec, {"det": det, "pose": pose}), det, pose
+
+
+def make_pipe_engine(**kw):
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.serve import InferenceEngine
+
+    pipe, det, pose = detpose_pipeline()
+    kw.setdefault("mesh", create_mesh(1, 1))
+    kw.setdefault("buckets", (1, 4))
+    kw.setdefault("freeze_cache", True)
+    eng = InferenceEngine([det, pose], pipelines=[pipe], **kw)
+    return eng, pipe
+
+
+def entry_image(seed=0):
+    return np.random.RandomState(seed).rand(16, 16, 3).astype(np.float32)
+
+
+# ------------------------------------------- spec validation (no compile)
+
+
+def test_spec_rejects_cycle():
+    spec = PipelineSpec.from_json({
+        "name": "loop",
+        "input": {"shape": [8, 8, 3]},
+        "nodes": [
+            {"name": "a", "glue": "resize", "inputs": ["b"],
+             "params": {"size": 8}},
+            {"name": "b", "glue": "resize", "inputs": ["a"],
+             "params": {"size": 8}},
+        ],
+        "outputs": ["a"],
+    })
+    with pytest.raises(PipelineError, match="cycle"):
+        Pipeline(spec, {})
+
+
+def test_spec_rejects_duplicate_and_reserved_names():
+    dup = detpose_json()
+    dup["nodes"][1]["name"] = "detect"
+    with pytest.raises(PipelineError, match="duplicate"):
+        Pipeline(PipelineSpec.from_json(dup),
+                 {"det": toy_detector(), "pose": toy_pose()})
+    res = detpose_json()
+    res["nodes"][0]["name"] = "input"
+    with pytest.raises(PipelineError, match="reserved"):
+        Pipeline(PipelineSpec.from_json(res),
+                 {"det": toy_detector(), "pose": toy_pose()})
+
+
+def test_spec_rejects_unknown_references():
+    models = {"det": toy_detector(), "pose": toy_pose()}
+    body = detpose_json()
+    body["nodes"][0]["model"] = "nope"
+    with pytest.raises(PipelineError, match="unknown model"):
+        Pipeline(PipelineSpec.from_json(body), models)
+    body = detpose_json()
+    body["nodes"][1]["glue"] = "nope"
+    with pytest.raises(PipelineError, match="unknown glue"):
+        Pipeline(PipelineSpec.from_json(body), models)
+    body = detpose_json()
+    body["nodes"][1]["inputs"] = ["ghost"]
+    with pytest.raises(PipelineError, match="unknown node"):
+        Pipeline(PipelineSpec.from_json(body), models)
+    body = detpose_json()
+    body["outputs"] = [{"node": "ghost"}]
+    with pytest.raises(PipelineError, match="unknown node"):
+        Pipeline(PipelineSpec.from_json(body), models)
+
+
+def test_spec_rejects_aval_mismatched_edge():
+    # entry images are 16x16 but the pose stage was lowered for 8x8:
+    # the per-edge eval_shape walk must refuse at build time, before
+    # any compile could hide it as a runtime shape error
+    spec = PipelineSpec.from_json({
+        "name": "bad",
+        "input": {"shape": [16, 16, 3]},
+        "nodes": [{"name": "p", "model": "pose"}],
+        "outputs": ["p"],
+    })
+    with pytest.raises(PipelineError, match="aval mismatch"):
+        Pipeline(spec, {"pose": toy_pose()})
+    # a dict-valued stage output feeding a model node is equally invalid
+    spec = PipelineSpec.from_json({
+        "name": "bad2",
+        "nodes": [
+            {"name": "detect", "model": "det"},
+            {"name": "p2", "model": "det", "inputs": ["detect"]},
+        ],
+        "outputs": ["p2"],
+    })
+    with pytest.raises(PipelineError, match="array input"):
+        Pipeline(spec, {"det": toy_detector()})
+
+
+def test_spec_rejects_topk_beyond_candidates():
+    with pytest.raises(PipelineError, match="exceeds"):
+        detpose_pipeline(k=5)  # the toy detector emits 3 candidates
+
+
+def test_spec_rejects_mask_fanout_mismatch():
+    body = detpose_json()
+    # crop.valid has fan-out K=2 but the detect output has fan-out 1
+    body["outputs"] = [{"node": "detect", "mask": "crop.valid"}]
+    with pytest.raises(PipelineError, match="fan-out"):
+        Pipeline(PipelineSpec.from_json(body),
+                 {"det": toy_detector(), "pose": toy_pose()})
+
+
+def test_bind_rejects_ladder_not_divisible_by_mesh(mesh8):
+    from deepvision_tpu.serve.compile_cache import CompileCache
+
+    pipe, _, _ = detpose_pipeline(pose_buckets=(1, 2, 8))
+    with pytest.raises(PipelineError, match="not divisible"):
+        pipe.bind(CompileCache(max_entries=8), mesh8)
+
+
+def test_entry_geometry_inferred_and_explicit():
+    pipe, det, _ = detpose_pipeline()
+    assert pipe.input_shape == tuple(det.input_shape)
+    assert np.dtype(pipe.input_dtype) == np.float32
+    assert pipe.dtype_str == "float32"
+    # glue-fronted DAG (the pipeline-smoke resize->model shape): entry
+    # geometry is NOT inferable, so an explicit input block is required
+    body = {
+        "name": "rp",
+        "nodes": [
+            {"name": "shrink", "glue": "resize", "params": {"size": 8}},
+            {"name": "p", "model": "pose", "inputs": ["shrink"]},
+        ],
+        "outputs": ["p"],
+    }
+    with pytest.raises(PipelineError, match="explicit input"):
+        Pipeline(PipelineSpec.from_json(body), {"pose": toy_pose()})
+    body["input"] = {"shape": [32, 32, 3]}
+    pipe = Pipeline(PipelineSpec.from_json(body), {"pose": toy_pose()})
+    assert pipe.input_shape == (32, 32, 3)
+
+
+def test_chunk_plan_policy():
+    # full max-ladder chunks first, then one padded tail chunk at the
+    # smallest bucket that fits the remainder
+    assert chunk_plan(20, (1, 4, 16)) == [(0, 16, 16), (16, 4, 4)]
+    assert chunk_plan(7, (1, 4, 16)) == [(0, 7, 16)]
+    assert chunk_plan(3, (1, 4, 16)) == [(0, 3, 4)]
+    assert chunk_plan(1, (1, 4, 16)) == [(0, 1, 1)]
+    assert chunk_plan(33, (16,)) == [(0, 16, 16), (16, 16, 16),
+                                     (32, 1, 16)]
+    for bad in ((0, (1, 4)), (4, ())):
+        with pytest.raises(PipelineError):
+            chunk_plan(*bad)
+
+
+def test_load_pipeline_specs_accepts_all_forms(tmp_path):
+    import json
+
+    body = detpose_json()
+    single = tmp_path / "one.json"
+    single.write_text(json.dumps(body))
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({"pipelines": [body, dict(
+        body, name="other")]}))
+    assert [s.name for s in load_pipeline_specs(single)] == ["detpose"]
+    specs = load_pipeline_specs(wrapped)
+    assert [s.name for s in specs] == ["detpose", "other"]
+    assert specs[0].buckets == (1, 4)
+    assert [n.name for n in specs[0].nodes] == [
+        "detect", "people", "crop", "posestage"]
+
+
+# ------------------------------------------------ the out_avals seam
+
+
+def test_export_out_avals_stablehlo_round_trip(tmp_path):
+    """A serialized StableHLO artifact reloads with the exact output
+    signature the pipeline validator needs to type-check a DAG edge
+    before any compile — and still computes the same numbers."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepvision_tpu import export as exp
+
+    variables = {"w": np.float32(3.0)}
+
+    def apply_fn(v, x):
+        return {"y": x * v["w"], "s": jnp.sum(x, axis=1)}
+
+    sample = np.linspace(0, 1, 10, dtype=np.float32).reshape(2, 5)
+    data = exp.export_forward(apply_fn, variables, sample,
+                              train_kwarg=False)
+    call = exp.load_exported(exp.save_exported(tmp_path / "m.shlo", data))
+
+    assert [tuple(a.shape) for a in call.in_avals] == [(2, 5)]
+    expected_tree = jax.eval_shape(
+        lambda x: apply_fn(variables, x),
+        jax.ShapeDtypeStruct((2, 5), np.float32))
+    expected = sorted(
+        (tuple(leaf.shape), np.dtype(leaf.dtype).name)
+        for leaf in jax.tree_util.tree_leaves(expected_tree))
+    assert sorted((tuple(a.shape), np.dtype(a.dtype).name)
+                  for a in call.out_avals) == expected
+    out = call(sample)
+    np.testing.assert_allclose(np.asarray(out["y"]), sample * 3.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["s"]), sample.sum(axis=1),
+                               rtol=1e-6)
+
+
+def test_served_model_as_stage_exposes_avals():
+    det = toy_detector()
+    stage = det.as_stage()
+    (x_aval,) = stage.in_avals(4)
+    assert tuple(x_aval.shape) == (4, 16, 16, 3)
+    out = stage.out_avals(4)
+    assert tuple(out["boxes"].shape) == (4, 3, 4)
+    assert tuple(out["scores"].shape) == (4, 3)
+    assert np.dtype(out["classes"].dtype) == np.int32
+
+
+# ------------------------------------------------- compile-cache freeze
+
+
+def test_compile_cache_freeze_contract():
+    from deepvision_tpu.serve.compile_cache import CompileCache
+
+    c = CompileCache(max_entries=4)
+    assert c.get_or_build(("m", 1, "f32"), lambda: "r1") == "r1"
+    c.freeze()
+    # hits still serve; a miss is a warmup-coverage bug and raises
+    assert c.get_or_build(("m", 1, "f32"), lambda: "r2") == "r1"
+    with pytest.raises(RuntimeError, match="frozen"):
+        c.get_or_build(("m", 2, "f32"), lambda: "r3")
+    s = c.stats()
+    assert s["frozen"] is True and s["misses"] == 1 and s["hits"] == 1
+
+
+# --------------------------------------------- engine-served pipelines
+
+
+def test_ragged_fanout_chunk_accounting_and_frozen_counters():
+    """One image fans out to K=2 crops (pose chunk [(0,2,2)]); an
+    entry-bucket-4 batch fans out to 8 (one full bucket-8 chunk). The
+    frozen cache proves warm() covered every one of those executables:
+    misses stay flat across live traffic while hits grow."""
+    eng, pipe = make_pipe_engine()
+    with eng:
+        warm_stats = eng._cache.stats()
+        assert warm_stats["frozen"] is True
+        # chunk accounting is a compile-time property: rebuilding the
+        # runner per entry bucket is all cache hits (frozen!) and
+        # records the plan each stage will execute at that bucket
+        pipe.compile_for(1, eng._mesh)
+        assert pipe.last_chunk_plans["detect"] == [(0, 1, 1)]
+        assert pipe.last_chunk_plans["posestage"] == [(0, 2, 2)]
+        pipe.compile_for(4, eng._mesh)
+        assert pipe.last_chunk_plans["detect"] == [(0, 4, 4)]
+        # entry bucket 4 -> 4*K=8 crop rows -> one full bucket-8 chunk
+        assert pipe.last_chunk_plans["posestage"] == [(0, 8, 8)]
+        rebuild_stats = eng._cache.stats()
+        assert rebuild_stats["misses"] == warm_stats["misses"]
+
+        r1 = eng.submit(entry_image(), model="detpose").result(timeout=60)
+        assert len(r1["posestage"]) == 2  # both crops valid
+        eng.pause()
+        futs = [eng.submit(entry_image(i), model="detpose")
+                for i in range(3)]
+        eng.resume()
+        for f in futs:
+            f.result(timeout=60)
+        live_stats = eng._cache.stats()
+        assert live_stats["misses"] == warm_stats["misses"]
+        assert live_stats["hits"] > warm_stats["hits"]
+        assert eng.stats()["pipelines"] == {"detpose": 4}
+
+
+def test_pipeline_parity_vs_sequential_per_task_head():
+    """detect -> crop -> pose through the DAG decides exactly what two
+    sequential /v1/predict hops decide, per task head at the PR 3
+    cross-bucket tolerances (detect rtol 5e-3, pose rtol 1e-4)."""
+    from deepvision_tpu.ops.crop_resize import crop_and_resize
+
+    eng, _ = make_pipe_engine()
+    with eng:
+        x = entry_image(7)
+        piped = eng.submit(x, model="detpose").result(timeout=60)
+
+        seq_det = eng.submit(x, model="det").result(timeout=60)
+        assert piped["detect"]["classes"] == seq_det["classes"]
+        np.testing.assert_allclose(piped["detect"]["boxes"],
+                                   seq_det["boxes"],
+                                   rtol=5e-3, atol=1e-6)
+        np.testing.assert_allclose(piped["detect"]["scores"],
+                                   seq_det["scores"],
+                                   rtol=5e-3, atol=1e-6)
+
+        # sequential pose leg: top-2 boxes by score from the detect
+        # answer, cropped host-side, one /v1/predict each
+        order = np.argsort(np.asarray(seq_det["scores"]))[::-1][:2]
+        boxes = np.asarray(seq_det["boxes"], np.float32)[order]
+        crops = np.asarray(crop_and_resize(x[None], boxes[None], 8))[0]
+        assert len(piped["posestage"]) == 2
+        for j in range(2):
+            seq_pose = eng.submit(crops[j], model="pose").result(
+                timeout=60)
+            np.testing.assert_allclose(
+                np.asarray(piped["posestage"][j]["joints"]),
+                np.asarray(seq_pose["joints"]), rtol=1e-4, atol=1e-6)
+
+
+def test_deadline_expiry_mid_dag_fails_exactly_once():
+    """A request whose deadline passes while the DAG is mid-flight gets
+    TimeoutError (never a late answer), counted once, with its
+    admission slot released so the next request proceeds."""
+    eng, pipe = make_pipe_engine()
+    with eng:
+        before = eng.telemetry.snapshot()
+        pipe.stage_hook = lambda name: time.sleep(0.2)
+        try:
+            fut = eng.submit(entry_image(), model="detpose",
+                             timeout_s=0.5)
+            with pytest.raises(TimeoutError, match="mid-pipeline"):
+                fut.result(timeout=60)
+        finally:
+            pipe.stage_hook = None
+        after = eng.telemetry.snapshot()
+        assert after["timed_out"] - before["timed_out"] == 1
+        assert after["completed"] == before["completed"]
+        # it WAS dispatched (mid-DAG, not queue-time, expiry) ...
+        assert pipe.requests_served == 1
+        # ... and the slot was released: the engine still serves
+        ok = eng.submit(entry_image(), model="detpose").result(timeout=60)
+        assert len(ok["posestage"]) == 2
+        assert eng.telemetry.snapshot()["timed_out"] == after["timed_out"]
+
+
+def test_clean_shutdown_no_leaked_threads():
+    base = set(threading.enumerate())
+    eng, _ = make_pipe_engine()
+    futs = [eng.submit(entry_image(i), model="detpose")
+            for i in range(3)]
+    for f in futs:
+        f.result(timeout=60)
+    eng.close()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t not in base and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"threads leaked past close(): {leaked}"
+    eng.close()  # idempotent
+
+
+def test_cross_stage_trace_flow_assert_flow(tmp_path):
+    """One trace id flows router -> replica queue -> device -> every
+    ``stage:<node>`` span: two spools (a synthetic router process + the
+    live engine process) merge into one timeline and the SAME
+    ``--assert-flow`` CLI gate the fleet smoke runs passes on it."""
+    from deepvision_tpu.obs.distributed import SpanSpool
+    from deepvision_tpu.obs.trace import Tracer, get_tracer
+    from tools import trace_merge
+
+    tid = "ab12" * 8
+    router_tracer = Tracer()
+    router_tracer.set_labels(role="router")
+    rspool = SpanSpool(tmp_path, role="router", tracer=router_tracer)
+    eng, _ = make_pipe_engine()  # warm BEFORE spooling: no warmup spans
+    gspool = SpanSpool(tmp_path, role="r1", tracer=get_tracer())
+    try:
+        with eng:
+            t0 = time.perf_counter()
+            fut = eng.submit(entry_image(), model="detpose", trace=tid)
+            res = fut.result(timeout=60)
+            router_tracer.record_span(
+                "router_attempt", t0, time.perf_counter(),
+                cat="router", args={"trace": tid, "replica": "r1"})
+        assert len(res["posestage"]) == 2
+    finally:
+        gspool.close()
+        rspool.close()
+
+    merged = trace_merge.merge(trace_merge.collect(tmp_path))
+    names = {e["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    for stage in ("detect", "people", "crop", "posestage"):
+        assert f"stage:{stage}" in names
+    assert {"router_attempt", "replica_queue", "device"} <= names
+    assert merged["metadata"]["cross_process_flows"] >= 1
+    assert trace_merge.cross_process_requests(merged) >= 1
+    # the exact CLI gate the fleet smoke runs
+    rc = trace_merge.main([
+        str(tmp_path), "--assert-flow", "--assert-spans",
+        "router_attempt,device,stage:detect,stage:posestage"])
+    assert rc == 0

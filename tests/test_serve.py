@@ -253,7 +253,7 @@ def test_compile_cache_lru_eviction_and_counters():
     assert not cc.contains("b")
     stats = cc.stats()
     assert stats == {"entries": 2, "hits": 1, "misses": 3,
-                     "evictions": 1}
+                     "evictions": 1, "frozen": False}
     assert built == ["a", "b", "c"]
 
 

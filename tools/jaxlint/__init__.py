@@ -93,6 +93,10 @@ Checker codes (tools/jaxlint/checkers.py):
     JX126  inline PartitionSpec(...) in model/step code — sharding
            decisions belong in the [[shardcheck.rule]] table or
            core/'s spec-building helpers
+    JX127  jax.device_get/np.asarray/.block_until_ready() on an
+           inter-stage value inside a pipeline execution path
+           (``pipeline_funcs`` knob) — stage outputs must stay
+           device-resident until the engine's single final fetch
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

@@ -30,6 +30,7 @@ from tools.jaxlint.core import (
     call_name,
     dotted_name,
     is_host_blocking_call,
+    iter_own_nodes,
     last_attr,
     path_matches_dir,
     register_checker,
@@ -1705,6 +1706,65 @@ class InlinePartitionSpecChecker(Checker):
                     "code — declare the sharding as a "
                     "[[shardcheck.rule]] row (regex path -> spec) so "
                     "the coverage audit and the sharding engine see it")
+
+
+@register_checker
+class PipelineHostRoundTripChecker(Checker):
+    """Host fetch of an inter-stage value inside a pipeline execution
+    path: the served DAG (``serve/pipeline.py``) exists to keep stage
+    outputs device-resident between compiled stages — a ``jax.device_get``
+    / ``np.asarray`` / ``.block_until_ready()`` there re-introduces the
+    per-hop host round-trip (plus the dispatch-pipeline stall) the
+    subsystem removes, and it does so silently: results stay correct,
+    only the latency contract breaks. The engine's single final fetch
+    after the whole DAG is the one sanctioned ``device_get``. Which
+    functions count as pipeline execution paths is the
+    ``pipeline_funcs`` knob (name patterns, ``jaxlint.toml``);
+    helper-routed syncs are flagged through the project blocking-
+    callable summary, same as JX109."""
+
+    code = "JX127"
+    name = "host-round-trip-in-pipeline"
+    description = ("jax.device_get / np.asarray / .block_until_ready() "
+                   "on an inter-stage value inside a pipeline execution "
+                   "path (re-introduces the host hop the DAG removes)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.pipeline_funcs
+        for info in mod.functions:
+            if not any(fnmatch.fnmatch(info.node.name, p)
+                       for p in patterns):
+                continue
+            # own body only: a nested def is its own FunctionInfo and
+            # is matched (or not) on its own name
+            for sub in iter_own_nodes(info.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+                method = (sub.func.attr
+                          if isinstance(sub.func, ast.Attribute)
+                          else None)
+                if is_host_blocking_call(sub):
+                    label = name or f".{method}()"
+                    yield mod.finding(
+                        sub, self.code,
+                        f"'{label}' fetches/syncs an inter-stage value "
+                        f"inside pipeline path '{info.node.name}': "
+                        "stage outputs must stay device-resident until "
+                        "the engine's single final fetch — drop the "
+                        "host hop (decode belongs in postprocess, "
+                        "after device_get)")
+                    continue
+                helper = mod.call_blocks_host(sub)
+                if helper is not None:
+                    yield mod.finding(
+                        sub, self.code,
+                        f"'{name or helper}' blocks the host inside "
+                        f"pipeline path '{info.node.name}' (the helper "
+                        f"'{helper}' transitively calls np.asarray/"
+                        "block_until_ready/device_get): inter-stage "
+                        "values must stay device-resident until the "
+                        "engine's final fetch")
 
 
 # concurrency tier (JX118-JX122, ISSUE 14): importing for registration

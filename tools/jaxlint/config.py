@@ -272,6 +272,16 @@ class LintConfig:
     signal_safe_calls: list[str] = field(default_factory=lambda: [
         "flight_dump", "self.dump",
     ])
+    # Function-name patterns treated as pipeline execution paths
+    # (JX127): the device-resident DAG runner and its per-stage
+    # executors (serve/pipeline.py naming contract). A jax.device_get /
+    # np.asarray / .block_until_ready() on an inter-stage value there
+    # re-introduces the host round-trip the pipeline subsystem exists
+    # to remove — stage outputs must stay device arrays until the
+    # engine's single final fetch.
+    pipeline_funcs: list[str] = field(default_factory=lambda: [
+        "*pipeline*", "*_stage*", "run_dag*", "*_dag_*",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -293,7 +303,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
         "timed_funcs", "loop_sleep_funcs", "wire_funcs",
         "cluster_funcs", "sentinel_funcs", "span_funcs",
-        "precision_funcs",
+        "precision_funcs", "pipeline_funcs",
         "lock_name_patterns", "lock_blocking_calls", "collective_calls",
         "fork_unsafe_imports", "signal_safe_calls",
         "mesh_axis_names", "mesh_axis_home", "multidevice_dirs",
