@@ -145,6 +145,41 @@ serve-smoke:
 # (tools/pipeline_smoke.py): decision parity vs the sequential client,
 # flat post-warm miss counter, per-stage spans merged and verified by
 # the trace_merge --assert-flow gate. Evidence log under logs/.
+# Crash-safe stateful sessions (PR 19): 4 synthetic video streams x 12
+# frames through the tracking pipeline on a 2-replica fleet, with a
+# replica SIGKILLed mid-stream. Gates: every frame answered, ZERO
+# stream resets (state_reset=false on every response — migrated
+# streams restore from shared snapshots + windowed replay), and the
+# router exit line proves streams actually migrated (sessions_migrated
+# >= 1) while the reset counter stayed at 0.
+stream-smoke:
+	@mkdir -p logs; L="logs/stream-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	$(PY) -c "import json, numpy as np; \
+	    rng = np.random.default_rng(0); \
+	    [print(json.dumps({'id': f'cam{s}-{i}', 'model': 'track', \
+	     'session': f'cam{s}', 'seq': i, \
+	     'input': (rng.standard_normal((16, 16, 1)) * 0.3).tolist()})) \
+	     for i in range(12) for s in range(4)]" \
+	| $(PY) serve.py --fleet 2 --track synth:4 --buckets 4 \
+	    --snapshot-every 3 --faults replica_kill@20 --timeout-s 20 \
+	    2> "$$L" \
+	| $(PY) -c "import sys, json; \
+	    rows = [json.loads(l) for l in sys.stdin if l.strip()]; \
+	    ok = [r for r in rows if 'result' in r]; \
+	    assert len(ok) == 48, (len(ok), rows[:3]); \
+	    resets = [r for r in ok if r['result'].get('state_reset')]; \
+	    assert not resets, resets[:3]; \
+	    seqs = {}; \
+	    [seqs.setdefault(r['result']['session'], []).append( \
+	        r['result']['seq']) for r in ok]; \
+	    assert all(v == sorted(v) for v in seqs.values()), seqs; \
+	    print('stream-smoke stream OK (48/48 frames, 0 resets)')" && \
+	grep -qE "sessions_migrated=[1-9]" "$$L" && \
+	grep -qE " resets=0" "$$L" && \
+	grep -qE "deaths=1" "$$L" && \
+	echo "stream-smoke OK (replica SIGKILLed mid-stream, streams" \
+	     "migrated, zero resets)"
+
 pipeline-smoke:
 	@mkdir -p logs; L="logs/pipeline-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
 	$(PY) -c "import json; print(json.dumps({'name': 'lenetpipe', \
@@ -369,7 +404,7 @@ threadcheck-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint lint-comms serve-smoke pipeline-smoke router-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke zero1-smoke
+check: lint lint-comms serve-smoke pipeline-smoke router-smoke stream-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke zero1-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -493,4 +528,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-threads lint-ir lint-comms bf16-ready precision-smoke zero1-smoke check serve-smoke pipeline-smoke router-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-threads lint-ir lint-comms bf16-ready precision-smoke zero1-smoke check serve-smoke pipeline-smoke router-smoke stream-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal

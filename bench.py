@@ -2027,6 +2027,189 @@ def serve_sweep_bench() -> dict:
     }
 
 
+# ------------------------------------------- stateful stream chaos drill
+
+# N synthetic video streams driven through the stateful tracking
+# pipeline on a 2-replica fleet; a replica is killed mid-stream and the
+# drill gates on the crash-safe session contract: zero stream resets
+# (every migrated stream restores from snapshot + replay), per-stream
+# frame ordering preserved across the failover, p95 frame latency in
+# budget, and a fault-free twin run producing BIT-IDENTICAL outputs
+# (the determinism pin: failover must not change results, only move
+# where they're computed).
+STREAMS_N = int(os.environ.get("STREAMS_N", "4"))
+STREAMS_FRAMES = int(os.environ.get("STREAMS_FRAMES", "40"))
+STREAM_P95_BUDGET_MS = float(os.environ.get("STREAM_P95_BUDGET_MS",
+                                            "2000"))
+
+
+def _stream_fleet(snap_dir: str, n: int = 2):
+    """In-process 2-replica fleet serving the synthetic tracking
+    pipeline; replicas SHARE ``snap_dir`` (the cross-replica restore
+    path the kill exercises). Single-bucket ladder: batch composition
+    can't vary between the fault run and its twin."""
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.obs.metrics import Registry
+    from deepvision_tpu.serve import EngineReplica, FleetRouter
+    from deepvision_tpu.serve.sessions import (
+        SessionStore,
+        TrackingPipeline,
+        synthetic_detector,
+    )
+    from deepvision_tpu.serve.telemetry import RouterTelemetry
+
+    def factory(sid):
+        def build():
+            det = synthetic_detector()
+            store = SessionStore(snapshot_dir=snap_dir, snapshot_every=4)
+            return [det, TrackingPipeline("track", det, store,
+                                          detect_every=4)]
+
+        return EngineReplica(sid, build, mesh=create_mesh(1, 1),
+                             buckets=(4,))
+
+    # private registry: the fault fleet and its twin run in one process
+    return FleetRouter(factory, replicas=n, models=["synth", "track"],
+                       max_queue=1024, default_deadline_s=60.0,
+                       telemetry=RouterTelemetry(registry=Registry()))
+
+
+def _stream_drill(snap_dir: str, frames: dict,
+                  kill_at_frame: int | None = None) -> dict:
+    """Drive every stream through its frames in seq order (streams
+    interleaved). With ``kill_at_frame``, wait for that frame round to
+    complete, then kill the replica holding the most stream pins —
+    the remaining frames must flow through migration + snapshot
+    restore + windowed replay."""
+    import collections
+
+    router = _stream_fleet(snap_dir)
+    try:
+        streams = sorted(frames)
+        n_frames = len(frames[streams[0]])
+        lock = threading.Lock()
+        order: dict = collections.defaultdict(list)
+        lats: list = []
+
+        def mk_cb(s, f, t0):
+            def cb(_fut):
+                t = time.perf_counter()
+                with lock:
+                    order[s].append(f)
+                    lats.append((t - t0) * 1e3)
+
+            return cb
+
+        futs = []
+        for f in range(n_frames):
+            round_futs = []
+            for s in streams:
+                t0 = time.perf_counter()
+                fut = router.submit(frames[s][f], model="track",
+                                    session=s, seq=f)
+                fut.add_done_callback(mk_cb(s, f, t0))
+                futs.append((s, f, fut))
+                round_futs.append(fut)
+            if f == kill_at_frame:
+                # let the round land so the victim has real state +
+                # cadence snapshots, then SIGKILL-analog it (EngineReplica
+                # .kill() abandons sessions without a flush — recovery
+                # runs off the cadence snapshots, the crash semantics)
+                for fut in round_futs:
+                    fut.result(timeout=120)
+                pins = router.stats()["sessions"]["pins"]
+                with router._lock:
+                    ready = {sl.sid: sl for sl in router._slots
+                             if sl.state == "ready"}
+                counts = collections.Counter(
+                    p for p in pins.values() if p in ready)
+                victim = ready[counts.most_common(1)[0][0]]
+                print(f"# killing {victim.sid} after frame {f} "
+                      f"({counts[victim.sid]} pinned stream(s))",
+                      file=sys.stderr)
+                victim.replica.kill()
+        outs = {}
+        resets = 0
+        for s, f, fut in futs:
+            r = fut.result(timeout=180)
+            if r.get("state_reset"):
+                resets += 1
+            outs[(s, f)] = (r["boxes"], r["scores"], r["tracked"])
+        tele = router.telemetry
+        return {"outs": outs, "order": dict(order), "lats": lats,
+                "resets": resets, "migrated": tele.sessions_migrated,
+                "declared_resets": tele.session_resets,
+                "summary": tele.summary_line()}
+    finally:
+        router.close()
+
+
+def streams_bench() -> dict:
+    import shutil
+    import tempfile
+
+    rng = np.random.default_rng(7)
+    streams = [f"cam{i}" for i in range(STREAMS_N)]
+    frames = {
+        s: [np.asarray(rng.normal(scale=0.3, size=(16, 16, 1)),
+                       np.float32)
+            for _ in range(STREAMS_FRAMES)]
+        for s in streams}
+    kill_at = STREAMS_FRAMES // 2
+
+    d1 = tempfile.mkdtemp(prefix="dvtpu-streams-")
+    d2 = tempfile.mkdtemp(prefix="dvtpu-streams-twin-")
+    try:
+        print(f"# streams drill: {STREAMS_N} streams x "
+              f"{STREAMS_FRAMES} frames on a 2-replica fleet, killing "
+              f"the pinned replica after frame {kill_at}...",
+              file=sys.stderr)
+        fault = _stream_drill(d1, frames, kill_at_frame=kill_at)
+        print(f"# {fault['summary']}", file=sys.stderr)
+        print("# fault-free twin (determinism pin)...", file=sys.stderr)
+        twin = _stream_drill(d2, frames)
+        print(f"# {twin['summary']}", file=sys.stderr)
+    finally:
+        shutil.rmtree(d1, ignore_errors=True)
+        shutil.rmtree(d2, ignore_errors=True)
+
+    ordering_ok = all(
+        fault["order"].get(s, []) == list(range(STREAMS_FRAMES))
+        for s in streams)
+    p95 = float(np.percentile(fault["lats"], 95)) if fault["lats"] else 0.0
+    identical = fault["outs"] == twin["outs"]
+    gates = {
+        # the honesty contract: migration is fine, SILENT or declared
+        # state loss is not
+        "stream_resets_zero": (fault["resets"] == 0
+                               and fault["declared_resets"] == 0),
+        "ordering_ok": ordering_ok,
+        # the drill must actually have exercised a failover
+        "migrated_nonzero": fault["migrated"] >= 1,
+        "p95_in_budget": p95 <= STREAM_P95_BUDGET_MS,
+        "twin_no_migrations": twin["migrated"] == 0,
+        "twin_outputs_identical": identical,
+    }
+    return {
+        "metric": "stream_chaos_p95_ms",
+        "value": round(p95, 1),
+        "unit": "ms",
+        "streams": STREAMS_N,
+        "frames_per_stream": STREAMS_FRAMES,
+        "kill_after_frame": kill_at,
+        "stream_resets": fault["resets"],
+        "sessions_migrated": fault["migrated"],
+        "p95_ms": round(p95, 1),
+        "p95_budget_ms": STREAM_P95_BUDGET_MS,
+        "twin": {"sessions_migrated": twin["migrated"],
+                 "stream_resets": twin["resets"],
+                 "outputs_identical": identical},
+        "gates": gates,
+        "pass": all(gates.values()),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 if __name__ == "__main__":
 
     # BENCH_TRACE=path: span-trace the bench itself (the feed loops
@@ -2070,6 +2253,8 @@ if __name__ == "__main__":
             print(json.dumps(zero1_bench()))
         elif "pipeline" in sys.argv[1:]:
             print(json.dumps(pipeline_bench()))
+        elif "streams" in sys.argv[1:]:
+            print(json.dumps(streams_bench()))
         elif "serve" in sys.argv[1:]:
             if "--sweep" in sys.argv[1:]:
                 print(json.dumps(serve_sweep_bench()))

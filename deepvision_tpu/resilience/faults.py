@@ -74,6 +74,16 @@ Sites and their consultation points:
                     parameter leaf on the targeted host — the one-ulp
                     SDC only the fingerprint audit can see.
                     Alias: ``sdcp``.
+``session_kill``    per committed session-state update in the
+                    ``serve/sessions.py`` SessionStore; fires by
+                    dropping that session's device-resident state
+                    (snapshots on disk are kept), so the next frame
+                    exercises the snapshot-restore path in-process.
+                    Alias: ``sesskill``.
+``snapshot_corrupt``  per committed session snapshot; fires by garbling
+                    the just-written snapshot file on disk, so restore
+                    must fall back to the previous snapshot or declare
+                    an honest ``state_reset``. Alias: ``snapcorrupt``.
 ==================  =====================================================
 
 The sdc sites accept ``:hostH`` (e.g. ``sdc_grad@20:host1``) in the
@@ -116,7 +126,8 @@ __all__ = [
 # canonical site names + accepted aliases
 SITES = ("nan_step", "data_io", "ckpt_corrupt", "stall", "dispatch_crash",
          "replica_kill", "replica_slow", "host_preempt", "host_stall",
-         "worker_kill", "sdc_grad", "sdc_param")
+         "worker_kill", "sdc_grad", "sdc_param", "session_kill",
+         "snapshot_corrupt")
 # the sites the CLUSTER SUPERVISOR consults (resilience/cluster.py);
 # train_dist.py splits a mixed schedule on this set so supervisor-level
 # specs never reach the in-job injector (and vice versa)
@@ -137,6 +148,8 @@ _ALIASES = {
     "wkill": "worker_kill",
     "sdc": "sdc_grad",
     "sdcp": "sdc_param",
+    "sesskill": "session_kill",
+    "snapcorrupt": "snapshot_corrupt",
 }
 _HOST_ARG = re.compile(r"^host(\d+)$")
 
@@ -436,6 +449,23 @@ class FaultInjector:
                 self.fired.append(key)
                 return spec
         return None
+
+    def check_session_kill(self) -> bool:
+        """SessionStore hook, per committed session-state update: True
+        when that session's device-resident state should be dropped
+        (snapshots kept) so the next frame runs the restore path."""
+        return self._consult("session_kill") is not None
+
+    def corrupt_snapshot(self, path: str | Path) -> bool:
+        """SessionStore hook, per committed session snapshot: garble the
+        just-written snapshot file so restore must fall back to the
+        previous snapshot or declare an honest ``state_reset``."""
+        spec = self._consult("snapshot_corrupt")
+        if spec is None:
+            return False
+        Path(path).write_bytes(b"\x00injected-snapshot-corruption\x00")
+        print(f"[fault] corrupted session snapshot {path}", flush=True)
+        return True
 
     def corrupt_checkpoint(self, step_dir: str | Path) -> bool:
         """Checkpoint hook, per committed save: garble the largest file

@@ -66,10 +66,12 @@ _WAKE = object()  # queue sentinel: wake the dispatcher without a request
 
 
 class _Request:
-    __slots__ = ("model", "x", "future", "t_submit", "deadline", "trace")
+    __slots__ = ("model", "x", "future", "t_submit", "deadline", "trace",
+                 "session", "seq")
 
     def __init__(self, model: str, x, deadline: float | None,
-                 trace: str | None = None):
+                 trace: str | None = None, session: str | None = None,
+                 seq: int | None = None):
         self.model = model
         self.x = x
         self.future: Future = Future()
@@ -79,6 +81,10 @@ class _Request:
         # replica-side queue/device/postprocess spans so one request's
         # timeline assembles across the router and replica processes
         self.trace = trace
+        # stateful streams (serve/sessions.py): stream id + frame seq;
+        # None for the stateless paths
+        self.session = session
+        self.seq = seq
 
 
 class InferenceEngine:
@@ -213,7 +219,8 @@ class InferenceEngine:
         sharding = replicated_sharding(self._mesh)
         targets = []
         for m in self._models.values():
-            if getattr(m, "is_pipeline", False):
+            if getattr(m, "is_pipeline", False) \
+                    or getattr(m, "is_stateful", False):
                 # a pipeline's own variables are None; its STAGE models
                 # carry the weights (shared objects with the plain
                 # serving path when a model is served both ways)
@@ -254,7 +261,8 @@ class InferenceEngine:
                         bucket, self._mesh),
                 )
                 if m.precompiled is not None \
-                        or getattr(m, "is_pipeline", False):
+                        or getattr(m, "is_pipeline", False) \
+                        or getattr(m, "is_stateful", False):
                     # pipelines zero-execute too: their runners thread
                     # eager device ops (chunk slice/pad/concat, dict
                     # re-packing) between stage executables, and any
@@ -269,14 +277,21 @@ class InferenceEngine:
     # -- client surface --------------------------------------------------
     def submit(self, x, model: str | None = None, *,
                timeout_s: float | None = None,
-               trace: str | None = None) -> Future:
+               trace: str | None = None,
+               session: str | None = None,
+               seq: int | None = None) -> Future:
         """Enqueue one example (no batch dim) for ``model``; returns a
         Future resolving to the task's result dict. Raises
         :class:`ShedError` immediately when admission rejects, and
         ``ValueError`` on shape/model mismatch (fail fast, not in the
         dispatcher). ``trace`` is the request's distributed trace id
         (propagated from the router over ``X-DVTPU-Trace``): the
-        per-request queue/device/postprocess spans carry it."""
+        per-request queue/device/postprocess spans carry it.
+
+        Stateful models (``serve/sessions.py``) additionally require
+        ``session`` (stream id) + ``seq`` (frame number): the session's
+        device state threads through this same admission/deadline path,
+        and a NEW session is shed here when the store is at capacity."""
         if model is None:
             if len(self._models) != 1:
                 raise ValueError(
@@ -293,6 +308,25 @@ class InferenceEngine:
             raise ValueError(
                 f"{model!r} expects input shape {served.input_shape}, "
                 f"got {x.shape}")
+        if getattr(served, "is_stateful", False):
+            if session is None or seq is None:
+                raise ValueError(
+                    f"stateful model {model!r} requires session= and "
+                    "seq= on submit")
+            seq = int(seq)
+            if seq < 0:
+                raise ValueError(f"seq must be >= 0, got {seq}")
+            try:
+                # capacity sheds NEW sessions at the door; existing
+                # streams keep their state (never a silent reset)
+                served.store.admit(session)
+            except ShedError:
+                self.telemetry.record_shed()
+                raise
+        elif session is not None:
+            raise ValueError(
+                f"model {model!r} is stateless; session=/seq= is only "
+                "valid for stateful models")
         try:
             self._admission.admit(model)
         except ShedError:
@@ -303,7 +337,7 @@ class InferenceEngine:
             model, x,
             deadline=(time.perf_counter() + timeout_s
                       if timeout_s is not None else None),
-            trace=trace)
+            trace=trace, session=session, seq=seq)
         self._q.put(req)
         if self._stop.is_set():
             # raced close(): the dispatcher's exit drain may already
@@ -319,9 +353,14 @@ class InferenceEngine:
                 self._admission.release(model)
         return req.future
 
+    def _session_stores(self) -> dict:
+        """name -> SessionStore for every stateful model."""
+        return {name: m.store for name, m in self._models.items()
+                if getattr(m, "is_stateful", False)}
+
     def stats(self) -> dict:
         """JSON-able state for ``/stats`` and the bench report."""
-        return {
+        out = {
             "models": sorted(self._models),
             "pipelines": {
                 name: m.requests_served
@@ -334,6 +373,11 @@ class InferenceEngine:
             "cache": self._cache.stats(),
             "telemetry": self.telemetry.snapshot(),
         }
+        stores = self._session_stores()
+        if stores:
+            out["sessions"] = {name: s.stats()
+                               for name, s in sorted(stores.items())}
+        return out
 
     def health(self) -> dict:
         """Liveness for ``/healthz``: ``"recovering"`` while the
@@ -355,6 +399,19 @@ class InferenceEngine:
                 until = self._recover_until
             out["retry_after_s"] = round(
                 max(0.05, until - time.monotonic()), 3)
+        stores = self._session_stores()
+        if stores:
+            # stateful-serving liveness: live streams, device bytes
+            # pinned by their state, and the worst-case snapshot age
+            # (how much replay a crash right now would need)
+            agg = [s.stats() for s in stores.values()]
+            ages = [a["snapshot_age_s"] for a in agg
+                    if a["snapshot_age_s"] is not None]
+            out["sessions"] = {
+                "live": sum(a["live"] for a in agg),
+                "pinned_bytes": sum(a["pinned_bytes"] for a in agg),
+                "snapshot_age_s": max(ages) if ages else None,
+            }
         return out
 
     # pause/resume: used by drains and tests that need deterministic
@@ -433,6 +490,24 @@ class InferenceEngine:
             self._fill_window(pending, name, ladder_max)
             reqs = pending[name][:ladder_max]
             del pending[name][:ladder_max]
+            if getattr(served, "is_stateful", False):
+                # one frame per session per batch: the compiled update
+                # reads each row's PRE-batch slate, so two frames of one
+                # stream in a batch would both read stale state. Later
+                # frames return to the FRONT of the backlog in arrival
+                # order — per-stream FIFO holds across the deferral.
+                seen: set[str] = set()
+                keep: list[_Request] = []
+                defer: list[_Request] = []
+                for r in reqs:
+                    if r.session in seen:
+                        defer.append(r)
+                    else:
+                        seen.add(r.session)
+                        keep.append(r)
+                if defer:
+                    pending[name][:0] = defer
+                    reqs = keep
             # visible to the crash handler from the moment they leave
             # the backlog: a crash anywhere past the slice (deadline
             # expiry included) must fail THESE futures too, or their
@@ -548,6 +623,9 @@ class InferenceEngine:
 
         from deepvision_tpu.core.mesh import data_sharding
 
+        if getattr(served, "is_stateful", False):
+            self._run_stateful_batch(served, reqs)
+            return
         t_dispatch = time.perf_counter()
         n = len(reqs)
         bucket = self._bucket_for(served, n)
@@ -644,19 +722,171 @@ class InferenceEngine:
                     cat="serve", args={"trace": r.trace})
             self._admission.release(r.model)
 
+    def _run_stateful_batch(self, served, reqs: list[_Request]) -> None:
+        """Dispatch one batch of a stateful model (TrackingPipeline):
+        disposition each frame through the SessionStore, answer
+        duplicates idempotently, then run the detect and interpolate
+        sub-batches as separate compiled programs. State stays on
+        device — ONE ``device_get`` of the batch OUTPUT per sub-batch,
+        never a per-frame round trip on state leaves (the JX128
+        contract); the only state fetch is the store's on-cadence
+        snapshot inside ``commit``."""
+        store = served.store
+        t_dispatch = time.perf_counter()
+        frames = [(r, store.begin_frame(r.session, r.seq,
+                                        served.detect_every))
+                  for r in reqs]
+        dup = [(r, f) for r, f in frames if f.action == "duplicate"]
+        detect = [(r, f) for r, f in frames
+                  if f.action == "apply" and f.run_detect]
+        interp = [(r, f) for r, f in frames
+                  if f.action == "apply" and not f.run_detect]
+        now = time.perf_counter()
+        for r, _f in dup:
+            # idempotent replay/retry answer: seq already applied, no
+            # recompute, no state touched (same exactly-once releaser
+            # rule as everywhere else)
+            try:
+                r.future.set_result({"session": r.session, "seq": r.seq,
+                                     "replayed": True,
+                                     "state_reset": False})
+            except InvalidStateError:
+                continue
+            self.telemetry.record_request(
+                queue_wait_s=t_dispatch - r.t_submit,
+                e2e_s=now - r.t_submit)
+            self._admission.release(r.model)
+        for group, mode in ((detect, "detect"), (interp, "interp")):
+            if group:
+                self._run_stateful_group(
+                    served, store, group, mode, t_dispatch)
+
+    def _run_stateful_group(self, served, store, group,
+                            mode: str, t_dispatch: float) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from deepvision_tpu.core.mesh import data_sharding
+
+        n = len(group)
+        bucket = self._bucket_for(served, n)
+        x = np.zeros((bucket, *served.input_shape), served.input_dtype)
+        for i, (r, _f) in enumerate(group):
+            x[i] = r.x
+        try:
+            runner = self._cache.get_or_build(
+                (served.name, bucket, served.dtype_str),
+                lambda: served.compile_for(bucket, self._mesh),
+            )
+            zero = runner.zero_slates()
+            # stack per-session device rows (zero rows for fresh/reset
+            # streams and padding) into the batched slate pytree
+            slates = {
+                k: jnp.stack([
+                    group[i][1].entry.state[k]
+                    if i < n and group[i][1].entry.state is not None
+                    else zero[k][i]
+                    for i in range(bucket)])
+                for k in zero}
+            xd = jax.device_put(x, data_sharding(self._mesh, x.ndim))
+            t0 = time.perf_counter()
+            if mode == "detect":
+                new_slates, out = runner.update(slates, runner.detect(xd))
+            else:
+                new_slates, out = runner.advance(slates)
+            host = jax.device_get(out)  # ONE host sync for the batch
+            t_dev = time.perf_counter() - t0
+        except Exception as e:  # device/compile failure: fail the group
+            for r, _f in group:
+                self._fail_request(r, e)
+            return
+        self.telemetry.record_batch(bucket=bucket, rows=n, device_s=t_dev)
+        self._admission.observe_batch(t_dev, n)
+        tracer = get_tracer()
+        if tracer.active:
+            traces = [r.trace for r, _f in group if r.trace]
+            sessions = [r.session for r, _f in group]
+            tracer.record_span(
+                "device", t0, t0 + t_dev, cat="serve",
+                args={"model": served.name, "bucket": bucket, "rows": n,
+                      "mode": mode, "sessions": sessions,
+                      **({"traces": traces} if traces else {})})
+        now = time.perf_counter()
+        for i, (r, f) in enumerate(group):
+            # commit state FIRST: the stream's lineage advances even if
+            # this answer expired — the client's retry then dedupes as
+            # an idempotent duplicate instead of forking the stream
+            row = {k: new_slates[k][i] for k in new_slates}
+            store.commit(r.session, r.seq, row)
+            if r.deadline is not None and now > r.deadline:
+                # deadline honesty mid-batch (same rule as pipelines):
+                # never a late answer
+                try:
+                    r.future.set_exception(TimeoutError(
+                        f"deadline expired mid-batch after "
+                        f"{now - r.t_submit:.3f}s"))
+                except InvalidStateError:
+                    continue
+                self.telemetry.record_timeout()
+                self._admission.release(r.model)
+                continue
+            t_pp = time.perf_counter()
+            try:
+                result = served.postprocess(host, i)
+                # deterministic merge: identical across restore paths —
+                # the chaos drill's twin-run equality leans on this
+                result["session"] = r.session
+                result["seq"] = r.seq
+                result["detected"] = mode == "detect"
+                result["state_reset"] = bool(f.reset)
+            except Exception as e:
+                self._fail_request(r, e)
+                continue
+            try:
+                r.future.set_result(result)
+            except InvalidStateError:
+                pass
+            else:
+                self.telemetry.record_request(
+                    queue_wait_s=t_dispatch - r.t_submit,
+                    e2e_s=now - r.t_submit)
+                self._admission.release(r.model)
+            if r.trace and tracer.active:
+                # session id on the span: per-session flows assemble in
+                # the merged Perfetto timeline
+                tracer.record_span(
+                    "replica_queue", r.t_submit, t_dispatch, cat="serve",
+                    args={"trace": r.trace, "model": served.name,
+                          "session": r.session})
+                tracer.record_span(
+                    "postprocess", t_pp, time.perf_counter(), cat="serve",
+                    args={"trace": r.trace, "session": r.session})
+
     def _resolve_dropped(self, r: _Request) -> None:
         self._fail_request(r, RuntimeError("engine closed"))
 
     # -- lifecycle -------------------------------------------------------
-    def close(self, timeout: float = 10.0) -> None:
+    def close(self, timeout: float = 10.0, *,
+              abandon_sessions: bool = False) -> None:
         """Stop the dispatcher and join its thread; pending futures fail
-        with RuntimeError('engine closed'). Idempotent."""
+        with RuntimeError('engine closed'). Idempotent.
+
+        Stateful stores flush a final snapshot per dirty session on a
+        graceful close; ``abandon_sessions=True`` drops device state
+        WITHOUT flushing — crash semantics for in-process replica
+        kills, so recovery genuinely runs off the cadence snapshots."""
         if self._stop.is_set():
             return
         self._stop.set()
         self._paused.clear()
         self._q.put(_WAKE)
         self._thread.join(timeout)
+        stores = {id(s): s for s in self._session_stores().values()}
+        for s in stores.values():
+            if abandon_sessions:
+                s.abandon()
+            else:
+                s.flush()
 
     def __enter__(self) -> "InferenceEngine":
         return self

@@ -91,19 +91,26 @@ class EngineReplica:
 
     def kill(self) -> None:
         """Abrupt death: in-flight futures fail with 'engine closed',
-        subsequent requests/probes raise :class:`ReplicaDeadError`."""
+        subsequent requests/probes raise :class:`ReplicaDeadError`.
+        Session state is ABANDONED, not flushed — an in-process kill
+        must exercise the same cadence-snapshot recovery a SIGKILL
+        would, or the chaos drill proves nothing."""
         self._dead = True
-        self.stop()
+        if self._engine is not None:
+            self._engine.close(abandon_sessions=True)
 
     # -- serving surface -------------------------------------------------
     def request(self, model: str | None, x, *,
                 timeout_s: float | None = None,
-                trace: str | None = None) -> dict:
+                trace: str | None = None,
+                session: str | None = None,
+                seq: int | None = None) -> dict:
         if self._dead or self._engine is None:
             raise ReplicaDeadError(f"{self.replica_id}: replica is dead")
         try:
             fut = self._engine.submit(x, model=model, timeout_s=timeout_s,
-                                      trace=trace)
+                                      trace=trace, session=session,
+                                      seq=seq)
             return fut.result(
                 timeout=timeout_s + 1.0 if timeout_s is not None else None)
         except (ShedError, TimeoutError, ValueError):
@@ -299,7 +306,9 @@ class ProcessReplica:
     # -- serving surface -------------------------------------------------
     def request(self, model: str | None, x, *,
                 timeout_s: float | None = None,
-                trace: str | None = None) -> dict:
+                trace: str | None = None,
+                session: str | None = None,
+                seq: int | None = None) -> dict:
         import base64
 
         # binary wire format (serve.py `input_b64`): base64 raw bytes
@@ -313,6 +322,11 @@ class ProcessReplica:
         }
         if model is not None:
             payload["model"] = model
+        if session is not None:
+            # stateful stream frame: the child's SessionStore threads
+            # state by (session, seq)
+            payload["session"] = session
+            payload["seq"] = seq
         if timeout_s is not None:
             # carry the router's remaining deadline to the child, so
             # the replica stops working a request the router has

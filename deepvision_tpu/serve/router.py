@@ -44,6 +44,8 @@ from __future__ import annotations
 import sys
 import threading
 import time
+import zlib
+from collections import deque
 from concurrent.futures import (
     FIRST_COMPLETED,
     Future,
@@ -266,10 +268,11 @@ class _Request:
     """One routed request: resolve-once future + routing context."""
 
     __slots__ = ("model", "key", "x", "future", "t_submit", "deadline",
-                 "trace", "_resolved", "_lock")
+                 "trace", "session", "seq", "_resolved", "_lock")
 
     def __init__(self, model: str | None, x, deadline: float,
-                 key: str | None = None, trace: str | None = None):
+                 key: str | None = None, trace: str | None = None,
+                 session: str | None = None, seq: int | None = None):
         self.model = model
         self.key = key if key is not None else (model or "_default")
         self.x = x
@@ -280,6 +283,10 @@ class _Request:
         # unless an upstream surface already assigned one; every
         # attempt span and the replica-side spans carry it
         self.trace = trace if trace is not None else new_trace_id()
+        # stateful stream identity (serve/sessions.py): frames of one
+        # session hash-pin to a replica and dispatch strictly in order
+        self.session = session
+        self.seq = seq
         self._resolved = False
         self._lock = threading.Lock()
 
@@ -299,6 +306,23 @@ class _Request:
         except InvalidStateError:  # client cancelled; nothing to deliver
             pass
         return True
+
+
+class _SessionRoute:
+    """Router-side state for one sticky stream: the pinned replica slot
+    id, the strictly-FIFO frame queue, and the bounded client-side
+    replay window (the frames between the last snapshot and a replica
+    death that can be re-sent instead of declaring a reset)."""
+
+    __slots__ = ("sid", "pin", "queue", "active", "window", "last_used")
+
+    def __init__(self, sid: str, window: int):
+        self.sid = sid
+        self.pin: str | None = None      # slot id the stream sticks to
+        self.queue: list = []            # [(req, breaker, key)] FIFO
+        self.active = False              # a drain task is running
+        self.window: deque = deque(maxlen=window)  # [(seq, x)]
+        self.last_used = time.monotonic()
 
 
 class FleetRouter:
@@ -333,6 +357,7 @@ class FleetRouter:
         fault_injector=None,
         telemetry: RouterTelemetry | None = None,
         start: bool = True,
+        session_replay_window: int = 32,
     ):
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
@@ -354,6 +379,8 @@ class FleetRouter:
             slo_budget_s=self._slo or None)
         self._injector = fault_injector
         self._lock = threading.Lock()
+        self._session_replay_window = max(0, int(session_replay_window))
+        self._sessions: dict[str, _SessionRoute] = {}
         self._slots: list[_Slot] = []
         self._gen = 0
         self._breakers: dict[str, CircuitBreaker] = {}
@@ -458,13 +485,21 @@ class FleetRouter:
 
     def submit(self, x, model: str | None = None, *,
                timeout_s: float | None = None,
-               trace: str | None = None) -> Future:
+               trace: str | None = None,
+               session: str | None = None,
+               seq: int | None = None) -> Future:
         """Route one example; returns a Future resolving to the task's
         result dict. Sheds raise immediately (circuit open / admission),
         the same :class:`ShedError` contract as the engine. ``trace``
         carries an upstream trace id; absent, the router mints one —
         either way every replica attempt propagates it over the
-        ``X-DVTPU-Trace`` hop."""
+        ``X-DVTPU-Trace`` hop.
+
+        ``session``/``seq`` mark a stateful stream frame: frames of one
+        session hash-pin to a replica, dispatch strictly in submission
+        order (per-stream FIFO, streams still parallel), and survive
+        the pin's death via re-pin + snapshot restore + replay of the
+        router's bounded frame window."""
         if self._stop.is_set():
             raise RuntimeError("router is closed")
         # anonymous requests on a single-model fleet resolve to that
@@ -493,8 +528,12 @@ class FleetRouter:
                   if b is not None]
         budget = min(bounds) if bounds else self._default_deadline_s
         req = _Request(model, x, deadline=time.monotonic() + budget,
-                       key=key, trace=trace)
-        self._pool.submit(self._dispatch, req, breaker, key)
+                       key=key, trace=trace, session=session,
+                       seq=seq if seq is None else int(seq))
+        if session is not None:
+            self._enqueue_session(req, breaker, key)
+        else:
+            self._pool.submit(self._dispatch, req, breaker, key)
         return req.future
 
     # -- request lifecycle -----------------------------------------------
@@ -614,6 +653,160 @@ class FleetRouter:
         except Exception as e:  # coordinator bug: never strand the client
             self._finish(req, key, error=e)
 
+    # -- stateful streams (serve/sessions.py) ----------------------------
+    def _enqueue_session(self, req: _Request, breaker, key: str) -> None:
+        """Append one frame to its stream's FIFO and ensure exactly one
+        drain task runs per stream — frames of one session dispatch
+        strictly in submission order, sessions stay parallel."""
+        with self._lock:
+            route = self._sessions.get(req.session)
+            if route is None:
+                route = self._sessions[req.session] = _SessionRoute(
+                    req.session, self._session_replay_window)
+            route.last_used = time.monotonic()
+            route.queue.append((req, breaker, key))
+            if not route.active:
+                route.active = True
+                self._pool.submit(self._drain_session, route)
+
+    def _drain_session(self, route: _SessionRoute) -> None:
+        while True:
+            with self._lock:
+                if not route.queue:
+                    route.active = False
+                    return
+                req, breaker, key = route.queue.pop(0)
+            if self._stop.is_set():
+                self._finish(req, key,
+                             error=RuntimeError("router is closed"))
+                continue
+            try:
+                self._dispatch_stateful(route, req, breaker, key)
+            except Exception as e:  # drain bug: never strand the client
+                self._finish(req, key, error=e)
+
+    def _pin_slot(self, route: _SessionRoute
+                  ) -> tuple[_Slot | None, bool]:
+        """The stream's sticky slot (inflight-incremented), hash-picking
+        a fresh pin when none exists and MIGRATING (second return value)
+        when the old pin is no longer routable."""
+        with self._lock:
+            ready = sorted((s for s in self._slots if s.state == READY),
+                           key=lambda s: s.sid)
+            if route.pin is not None:
+                for s in ready:
+                    if s.sid == route.pin:
+                        s.inflight += 1
+                        return s, False
+            if not ready:
+                return None, False
+            # stable hash-pin: the same session lands on the same slot
+            # id across router restarts (crc32, not PYTHONHASHSEED)
+            slot = ready[zlib.crc32(route.sid.encode()) % len(ready)]
+            migrated = route.pin is not None
+            route.pin = slot.sid
+            slot.inflight += 1
+            return slot, migrated
+
+    def _replay_window(self, route: _SessionRoute, slot: _Slot,
+                       req: _Request, breaker) -> tuple[bool, bool]:
+        """Re-send the buffered frame window (seq < current) to a fresh
+        pin so it can rebuild state past its newest snapshot; the
+        replica dedupes already-covered seqs idempotently. Returns
+        (ok, reset_seen) — reset_seen propagates any state_reset a
+        replayed frame declared, so the client-visible frame never
+        hides a reset that happened during recovery."""
+        with self._lock:
+            frames = [(s, x) for s, x in route.window if s < req.seq]
+        reset_seen = False
+        for s, x in frames:
+            remaining = req.deadline - time.monotonic()
+            if remaining <= 0:
+                return False, reset_seen
+            replay = _Request(req.model, x,
+                              deadline=req.deadline, key=req.key,
+                              trace=req.trace, session=req.session,
+                              seq=s)
+            with self._lock:
+                # pair the increment _attempt's finally will decrement
+                slot.inflight += 1
+            ok, payload = self._attempt(replay, slot, breaker)
+            if not ok:
+                return False, reset_seen
+            if isinstance(payload, dict) and payload.get("state_reset"):
+                reset_seen = True
+        return True, reset_seen
+
+    def _dispatch_stateful(self, route: _SessionRoute, req: _Request,
+                           breaker, key: str) -> None:
+        """Coordinate one stream frame: sticky attempt on the pin, and
+        on pin death re-pin to a survivor + replay the frame window.
+        NEVER hedges — a duplicate in-flight frame could double-apply a
+        state update; retry safety comes from the replica's seq dedupe
+        instead."""
+        retries_left = self._max_retries
+        last_exc: BaseException | None = None
+        reset_seen = False
+        while True:
+            remaining = req.deadline - time.monotonic()
+            if remaining <= 0:
+                self._finish(req, key, error=last_exc or TimeoutError(
+                    "deadline expired before any replica answered"))
+                return
+            slot, migrated = self._pin_slot(route)
+            if slot is None:
+                self._finish(req, key, error=(
+                    last_exc if isinstance(last_exc, ShedError)
+                    else RouterShedError(
+                        "no replica available for pinned session",
+                        round(2 * self._probe_interval_s, 3))))
+                return
+            if migrated:
+                self.telemetry.inc("sessions_migrated")
+                with self._lock:
+                    n_replay = len(route.window)
+                print(f"[router] session {route.sid} re-pinned to "
+                      f"{slot.sid} (replaying {n_replay} frame(s))",
+                      file=sys.stderr, flush=True)
+                ok, rs = self._replay_window(route, slot, req, breaker)
+                reset_seen = reset_seen or rs
+                if not ok:
+                    # replay target failed mid-recovery: undo nothing
+                    # (replayed frames are idempotent), re-pin again
+                    with self._lock:
+                        slot.inflight = max(0, slot.inflight - 1)
+                    if retries_left <= 0:
+                        self._finish(req, key, error=last_exc
+                                     or ReplicaDeadError(
+                                         "replay target died"))
+                        return
+                    retries_left -= 1
+                    continue
+            ok, payload = self._attempt(req, slot, breaker)
+            if ok:
+                if isinstance(payload, dict):
+                    if reset_seen:
+                        payload["state_reset"] = True
+                    if payload.get("state_reset"):
+                        # the honesty counter: a DECLARED reset, never
+                        # a silent one
+                        self.telemetry.inc("session_resets")
+                with self._lock:
+                    route.window.append((req.seq, req.x))
+                    route.last_used = time.monotonic()
+                self._finish(req, key, result=payload)
+                return
+            last_exc = payload
+            if isinstance(payload, ReplicaDeadError):
+                # pin died: count the failover; the next loop pass
+                # re-pins (and replays) onto a survivor
+                self.telemetry.inc("failovers")
+                continue
+            if isinstance(payload, ValueError) or retries_left <= 0:
+                self._finish(req, key, error=payload)
+                return
+            retries_left -= 1
+
     def _slot_count(self) -> int:
         with self._lock:
             return len([s for s in self._slots
@@ -642,13 +835,20 @@ class FleetRouter:
             # queue/device spans, so trace_merge can draw the flow
             # router attempt -> replica execution (no-op unless the
             # tracer is active)
+            kw = {}
+            if req.session is not None:
+                # stateful frame: session/seq ride to the replica (only
+                # passed when set, so bare test doubles keep working)
+                kw = {"session": req.session, "seq": req.seq}
             with span("router_attempt", cat="router",
                       args={"trace": req.trace, "replica": slot.sid,
                             "model": req.key,
+                            **({"session": req.session}
+                               if req.session is not None else {}),
                             **({"hedge": True} if hedge else {})}):
                 result = slot.replica.request(
                     req.model, req.x, timeout_s=remaining,
-                    trace=req.trace)
+                    trace=req.trace, **kw)
         except ReplicaDeadError as e:
             breaker.record_failure()
             self._on_replica_dead(slot, str(e))
@@ -959,6 +1159,12 @@ class FleetRouter:
                 "inflight": s.inflight,
             } for s in self._slots]
             target = self._target
+            sessions = {
+                "live": len(self._sessions),
+                "replay_window": self._session_replay_window,
+                "pins": {r.sid: r.pin
+                         for r in self._sessions.values()},
+            }
         return {
             "models": sorted(self._models),
             "replicas": replicas,
@@ -968,6 +1174,7 @@ class FleetRouter:
             "breakers": {k: b.snapshot()
                          for k, b in self._breakers.items()},
             "health": self.health(),
+            "sessions": sessions,
             "telemetry": self.telemetry.snapshot(),
         }
 

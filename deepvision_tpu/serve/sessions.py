@@ -1,0 +1,715 @@
+"""Crash-safe stateful sessions: device-resident stream state that
+survives replica death.
+
+The fleet tier (PR 6) and the pipeline tier (PR 18) are stateless — a
+replica SIGKILL loses nothing a retry can't rebuild. Streaming
+workloads (ROADMAP item 4) break that: tracking-by-detection carries a
+per-stream track slate from frame to frame, and losing it mid-stream is
+a client-visible hard reset. This module makes that state a first-class
+recoverable resource, the same way PR 4 did for training checkpoints:
+
+- :class:`SessionStore` pins per-session device state (track slates —
+  flat ``{name: array}`` pytrees) with TTL eviction and a bounded
+  capacity that sheds NEW sessions at the door (old state is never
+  dropped to make room). On a configurable frame cadence it writes
+  incremental host-side snapshots, crash-safe via the PR 4 tmp +
+  ``os.replace`` manifest pattern: leaves are base64 RAW BYTES (bit
+  exact, not JSON floats) under a SHA-256 self-checksum, the newest
+  verified snapshot wins at restore, corrupt files are quarantined.
+
+- :class:`TrackingPipeline` is the first stateful DAG on PR 18's
+  compiled stages: a detector :class:`~.pipeline.ModelStage` runs every
+  Kth frame; between detections a compiled ``advance`` program
+  propagates the slate (constant-velocity + score decay); on detect
+  frames a compiled ``update`` program associates fresh detections to
+  the previous slate (nearest-center EMA). All three programs are
+  AOT-compiled per (bucket, mesh) and cached by the engine's compile
+  cache, and the slate never leaves the device on the frame path — the
+  only host round-trips are the on-cadence snapshots (the JX128 lint
+  contract).
+
+- Honesty contract: every stateful response carries ``state_reset`` —
+  False when the slate's lineage is intact (fresh stream, in-order
+  frame, snapshot restore + replay), True when state was genuinely
+  lost (no snapshot survives, or a sequence gap the replay window
+  couldn't cover). Never a silent reset.
+
+Chaos sites (``resilience/faults.py``): ``session_kill`` drops a
+committed session's device state (snapshots kept) so the next frame
+exercises restore in-process; ``snapshot_corrupt`` garbles the
+just-written snapshot so restore must fall back or declare the reset.
+
+This module imports jax lazily (method bodies only): the fleet parent
+process (``serve.py --fleet``) stays jax-free.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import itertools
+import json
+import os
+import re
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from deepvision_tpu.serve.admission import ShedError
+
+__all__ = [
+    "SessionStore",
+    "TrackingPipeline",
+    "synthetic_detector",
+    "SNAPSHOT_VERSION",
+]
+
+SNAPSHOT_VERSION = 1
+
+_tmp_seq = itertools.count()
+
+_SAFE_SID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+# SessionStore counters, exported as ``session_<name>`` metrics
+_COUNTERS = ("opened", "evicted_ttl", "shed_capacity", "snapshots",
+             "restores", "resets", "snapshot_corrupt", "killed",
+             "duplicates")
+
+
+def _safe_sid(sid: str) -> str:
+    """Filesystem-safe snapshot stem for a session id."""
+    if _SAFE_SID_RE.match(sid):
+        return sid
+    return "h" + hashlib.sha1(sid.encode()).hexdigest()[:16]
+
+
+class _Session:
+    __slots__ = ("sid", "state", "seq", "opened_t", "last_used",
+                 "snap_seq", "last_snap_t", "frames_since_snap")
+
+    def __init__(self, sid: str, now: float):
+        self.sid = sid
+        self.state = None        # device pytree (flat {name: array}) or None
+        self.seq = -1            # last APPLIED frame seq (-1: none)
+        self.opened_t = now
+        self.last_used = now
+        self.snap_seq = -1       # seq covered by the newest committed snapshot
+        self.last_snap_t = None  # wall-clock time of the newest snapshot
+        self.frames_since_snap = 0
+
+
+class _Frame:
+    """Disposition of one (sid, seq) arrival — what the engine does
+    with it. ``action`` is ``apply`` (run the DAG, commit state) or
+    ``duplicate`` (seq already applied: a replayed/retried frame; answer
+    idempotently without touching state)."""
+
+    __slots__ = ("entry", "action", "reset", "restored", "run_detect")
+
+    def __init__(self, entry, action, reset, restored, run_detect):
+        self.entry = entry
+        self.action = action
+        self.reset = reset
+        self.restored = restored
+        self.run_detect = run_detect
+
+
+class SessionStore:
+    """Bounded, TTL-evicted table of per-session device state with
+    crash-safe host snapshots.
+
+    Concurrency: one lock guards the table; the engine's dispatcher is
+    the only state writer (``begin_frame``/``commit``), ``admit`` runs
+    on submitter threads, the TTL sweep piggybacks on both.
+    """
+
+    def __init__(self, *, capacity: int = 64, ttl_s: float = 300.0,
+                 snapshot_dir: str | Path | None = None,
+                 snapshot_every: int = 8, keep_snapshots: int = 2,
+                 injector=None, registry=None):
+        self._lock = threading.RLock()
+        self._sessions: dict[str, _Session] = {}
+        self.capacity = max(1, int(capacity))
+        self.ttl_s = float(ttl_s)
+        self.snapshot_dir = Path(snapshot_dir) if snapshot_dir else None
+        if self.snapshot_dir is not None:
+            self.snapshot_dir.mkdir(parents=True, exist_ok=True)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.keep_snapshots = max(1, int(keep_snapshots))
+        self._injector = injector
+        self._c = {k: 0 for k in _COUNTERS}
+        self._registry = registry
+        if registry is not None:
+            for k in _COUNTERS:
+                registry.counter(f"session_{k}")
+
+    # -- internal ---------------------------------------------------------
+    def _count(self, key: str, n: int = 1) -> None:
+        self._c[key] += n
+        if self._registry is not None:
+            self._registry.counter(f"session_{key}").inc(n)
+
+    def _now(self) -> float:
+        return time.monotonic()
+
+    def _evict_expired_locked(self) -> list[tuple]:
+        """Drop expired sessions; returns snapshot-capture tasks for
+        the dirty ones. The CALLER writes them after releasing the lock
+        (snapshot file I/O never runs under ``_lock`` — a slow disk
+        must not stall every other stream's frame)."""
+        if self.ttl_s <= 0:
+            return []
+        now = self._now()
+        tasks = []
+        dead = [sid for sid, e in self._sessions.items()
+                if now - e.last_used > self.ttl_s]
+        for sid in dead:
+            # final snapshot so an evicted-then-resumed stream restores
+            # instead of resetting (snapshots also outlive eviction)
+            e = self._sessions[sid]
+            if e.state is not None and e.seq > e.snap_seq:
+                task = self._capture_locked(e)
+                if task is not None:
+                    tasks.append(task)
+            del self._sessions[sid]
+            self._count("evicted_ttl")
+        return tasks
+
+    # -- admission (engine.submit path) -----------------------------------
+    def admit(self, sid: str) -> None:
+        """Open or touch a session at submit time. Sheds NEW sessions
+        when the table is full — existing state is never dropped to
+        make room (that would be a silent reset)."""
+        tasks: list[tuple] = []
+        try:
+            with self._lock:
+                tasks = self._evict_expired_locked()
+                e = self._sessions.get(sid)
+                if e is not None:
+                    e.last_used = self._now()
+                    return
+                if len(self._sessions) >= self.capacity:
+                    self._count("shed_capacity")
+                    raise ShedError(
+                        f"session capacity {self.capacity} reached; new "
+                        f"session {sid!r} shed (existing streams keep "
+                        "their state)",
+                        retry_after_s=min(self.ttl_s, 5.0))
+                self._sessions[sid] = _Session(sid, self._now())
+                self._count("opened")
+        finally:
+            # eviction snapshots land even on the shed path
+            for task in tasks:
+                self._write_snapshot(*task)
+
+    # -- frame protocol (dispatcher path) ---------------------------------
+    def begin_frame(self, sid: str, seq: int, detect_every: int) -> _Frame:
+        """Disposition for one arriving frame. Restores from the newest
+        verified snapshot when device state is missing; declares (never
+        hides) a reset when lineage cannot be recovered."""
+        with self._lock:
+            e = self._sessions.get(sid)
+            if e is None:
+                # post-migration arrival without a fresh admit (the
+                # router replays straight into the new replica)
+                e = self._sessions[sid] = _Session(sid, self._now())
+                self._count("opened")
+            e.last_used = self._now()
+            restored = False
+            if e.state is None and e.seq < 0:
+                restored = self._restore_locked(e)
+            if seq <= e.seq:
+                self._count("duplicates")
+                return _Frame(e, "duplicate", False, restored, False)
+            reset = False
+            if e.seq < 0:
+                # no recoverable lineage: seq 0 is a legitimate fresh
+                # start; anything later means frames were lost
+                reset = seq > 0
+            elif seq != e.seq + 1:
+                # sequence gap the replay window didn't cover
+                reset = True
+            if reset:
+                e.state = None
+                self._count("resets")
+            run_detect = (e.state is None) or (seq % detect_every == 0)
+            return _Frame(e, "apply", reset, restored, run_detect)
+
+    def commit(self, sid: str, seq: int, state_row) -> None:
+        """Commit one applied frame's new device state. Runs the
+        snapshot cadence and the ``session_kill`` chaos site."""
+        task = None
+        with self._lock:
+            e = self._sessions.get(sid)
+            if e is None:  # evicted mid-flight; drop silently
+                return
+            e.state = state_row
+            e.seq = seq
+            e.last_used = self._now()
+            e.frames_since_snap += 1
+            inj = self._injector
+            if inj is not None and inj.check_session_kill():
+                # device state lost (as if the owning process died);
+                # snapshots survive, so the next frame restores
+                e.state = None
+                e.seq = -1
+                e.frames_since_snap = 0
+                self._count("killed")
+                print(f"[fault] dropped session {sid} device state "
+                      f"(seq {seq})", flush=True)
+                return
+            if (self.snapshot_dir is not None
+                    and e.frames_since_snap >= self.snapshot_every):
+                task = self._capture_locked(e)
+        if task is not None:  # file I/O outside the lock
+            self._write_snapshot(*task)
+
+    # -- snapshots --------------------------------------------------------
+    def _snap_path(self, sid: str, seq: int) -> Path:
+        return self.snapshot_dir / f"{_safe_sid(sid)}-{seq:012d}.snap.json"
+
+    def _capture_locked(self, e: _Session) -> tuple | None:
+        """Capture ``(sid, seq, state)`` for a snapshot and update the
+        cadence bookkeeping — runs UNDER the store lock, touches no
+        files. The captured state reference stays internally consistent
+        even if a later commit swaps ``e.state`` before the write
+        lands."""
+        if e.state is None or self.snapshot_dir is None:
+            return None
+        e.snap_seq = e.seq
+        e.last_snap_t = time.time()
+        e.frames_since_snap = 0
+        return (e.sid, e.seq, e.state)
+
+    def _write_snapshot(self, sid: str, seq: int, state) -> None:
+        """Encode + atomically write one captured snapshot — runs
+        OUTSIDE the store lock (device fetch and file I/O must not
+        stall other streams' frames)."""
+        import jax
+
+        host = jax.device_get(state)  # the ONE on-cadence host sync
+        leaves = {}
+        for name in sorted(host):
+            arr = np.asarray(host[name])
+            leaves[name] = {
+                "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+            }
+        body = {"version": SNAPSHOT_VERSION, "sid": sid, "seq": seq,
+                "leaves": leaves}
+        payload = json.dumps(body, sort_keys=True).encode()
+        doc = dict(body)
+        doc["sha256"] = hashlib.sha256(payload).hexdigest()
+        target = self._snap_path(sid, seq)
+        # PR 4 manifest pattern: unique tmp, one atomic os.replace
+        tmp = target.with_suffix(
+            f".json.tmp.{os.getpid()}.{next(_tmp_seq)}")
+        tmp.write_text(json.dumps(doc, sort_keys=True))
+        os.replace(tmp, target)
+        self._count("snapshots")
+        if self._injector is not None:
+            self._injector.corrupt_snapshot(target)
+        self._prune_snapshots(sid)
+
+    def _prune_snapshots(self, sid: str) -> None:
+        snaps = sorted(self.snapshot_dir.glob(f"{_safe_sid(sid)}-*.snap.json"))
+        for old in snaps[:-self.keep_snapshots]:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+
+    @staticmethod
+    def verify_snapshot(path: Path) -> tuple[bool, str]:
+        """(ok, reason) for one snapshot file — checksum + structure."""
+        try:
+            doc = json.loads(Path(path).read_text())
+        except (OSError, ValueError) as exc:
+            return False, f"unreadable: {exc}"
+        want = doc.pop("sha256", None) if isinstance(doc, dict) else None
+        if want is None:
+            return False, "missing sha256"
+        payload = json.dumps(doc, sort_keys=True).encode()
+        got = hashlib.sha256(payload).hexdigest()
+        if got != want:
+            return False, f"checksum mismatch {got[:12]} != {want[:12]}"
+        if doc.get("version") != SNAPSHOT_VERSION:
+            return False, f"version {doc.get('version')}"
+        return True, "ok"
+
+    @staticmethod
+    def load_snapshot(path: Path) -> tuple[int, dict]:
+        """Decode a VERIFIED snapshot into (seq, host pytree). Raw-byte
+        b64 leaves: the round trip is bit-exact."""
+        doc = json.loads(Path(path).read_text())
+        state = {}
+        for name, leaf in doc["leaves"].items():
+            buf = base64.b64decode(leaf["b64"])
+            state[name] = np.frombuffer(
+                buf, dtype=np.dtype(leaf["dtype"])).reshape(leaf["shape"])
+        return int(doc["seq"]), state
+
+    def _restore_locked(self, e: _Session) -> bool:
+        if self.snapshot_dir is None:
+            return False
+        snaps = sorted(
+            self.snapshot_dir.glob(f"{_safe_sid(e.sid)}-*.snap.json"),
+            reverse=True)  # newest first (seq is zero-padded)
+        for path in snaps:
+            ok, reason = self.verify_snapshot(path)
+            if not ok:
+                self._count("snapshot_corrupt")
+                print(f"[sessions] quarantined corrupt snapshot {path}: "
+                      f"{reason}", flush=True)
+                try:
+                    os.replace(path, path.with_suffix(".json.corrupt"))
+                except OSError:
+                    pass
+                continue
+            seq, host = self.load_snapshot(path)
+            # host leaves, not a bare device_put: the store knows no
+            # mesh. The next frame's batch stack places the row with
+            # the batch's sharding, and that frame's commit swaps in
+            # the compiled program's device rows.
+            e.state = host
+            e.seq = seq
+            e.snap_seq = seq
+            e.frames_since_snap = 0
+            self._count("restores")
+            return True
+        return False
+
+    def flush(self) -> int:
+        """Snapshot every session with un-snapshotted state (graceful
+        close). Returns the number of snapshots written."""
+        tasks = []
+        with self._lock:
+            if self.snapshot_dir is None:
+                return 0
+            for e in self._sessions.values():
+                if e.state is not None and e.seq > e.snap_seq:
+                    task = self._capture_locked(e)
+                    if task is not None:
+                        tasks.append(task)
+        for task in tasks:  # file I/O outside the lock
+            self._write_snapshot(*task)
+        return len(tasks)
+
+    def abandon(self) -> None:
+        """Drop all device state WITHOUT flushing — crash semantics for
+        in-process replica kills, so restore runs off the cadence
+        snapshots exactly as it would after a real SIGKILL."""
+        with self._lock:
+            for e in self._sessions.values():
+                e.state = None
+                e.seq = -1
+            self._sessions.clear()
+
+    # -- introspection ----------------------------------------------------
+    def pinned_bytes(self) -> int:
+        """Device bytes pinned by live session state — pure aval math,
+        no host sync."""
+        with self._lock:
+            total = 0
+            for e in self._sessions.values():
+                if e.state is None:
+                    continue
+                for leaf in e.state.values():
+                    total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            return total
+
+    def snapshot_age_s(self) -> float | None:
+        """Age of the STALEST live session's newest snapshot (worst-case
+        replay distance), None when nothing has been snapshotted."""
+        with self._lock:
+            ages = [time.time() - e.last_snap_t
+                    for e in self._sessions.values()
+                    if e.last_snap_t is not None]
+            return max(ages) if ages else None
+
+    def stats(self) -> dict:
+        with self._lock:
+            tasks = self._evict_expired_locked()
+            age = self.snapshot_age_s()
+            out = {
+                "live": len(self._sessions),
+                "capacity": self.capacity,
+                "ttl_s": self.ttl_s,
+                "pinned_bytes": self.pinned_bytes(),
+                "snapshot_age_s": (round(age, 3)
+                                   if age is not None else None),
+                "snapshot_every": self.snapshot_every,
+                "counters": dict(self._c),
+            }
+        for task in tasks:  # eviction snapshots, outside the lock
+            self._write_snapshot(*task)
+        return out
+
+
+# ------------------------------------------------------- track-slate math
+#
+# The slate is a fixed-shape flat pytree per stream — ``slots`` tracks:
+#   boxes    (slots, 4) f32   normalized corner boxes (x1, y1, x2, y2)
+#   velocity (slots, 4) f32   per-frame corner deltas
+#   scores   (slots,)   f32   confidence; <= 0 means an empty slot
+#   age      (slots,)   f32   frames since the track was (re)acquired
+#
+# Everything below is pure jnp over a leading batch dim, position
+# independent per row — the determinism pin the chaos drill gates on:
+# the same frames produce bit-identical slates regardless of which
+# replica, batch position, or restore path computed them.
+
+def slate_spec(slots: int) -> dict:
+    """{name: (shape, dtype)} for one stream's slate (no batch dim)."""
+    return {
+        "boxes": ((slots, 4), np.float32),
+        "velocity": ((slots, 4), np.float32),
+        "scores": ((slots,), np.float32),
+        "age": ((slots,), np.float32),
+    }
+
+
+def _zero_slate(slots: int, batch: int):
+    import jax.numpy as jnp
+
+    return {name: jnp.zeros((batch, *shape), dtype)
+            for name, (shape, dtype) in slate_spec(slots).items()}
+
+
+def _centers(boxes):
+    # (..., 4) corner boxes -> (..., 2) centers
+    return 0.5 * (boxes[..., :2] + boxes[..., 2:])
+
+
+def _track_update(slates, det, *, slots: int, ema: float):
+    """Detect-frame program: select the top ``slots`` detections and
+    associate them to the previous slate by nearest center (EMA blend,
+    per-frame velocity). Batched over the leading dim; fixed shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    scores = jnp.where(det["valid"], det["scores"], -1.0)
+    sel_scores, sel_idx = jax.lax.top_k(scores, slots)       # (B, slots)
+    sel_boxes = jnp.take_along_axis(
+        det["boxes"], sel_idx[..., None], axis=1)            # (B, slots, 4)
+    det_valid = sel_scores > 0.0
+
+    prev_boxes = slates["boxes"]
+    prev_valid = slates["scores"] > 0.0
+    # (B, prev, new) center distances, invalid prev slots pushed to +inf
+    dist = jnp.linalg.norm(
+        _centers(prev_boxes)[:, :, None, :]
+        - _centers(sel_boxes)[:, None, :, :], axis=-1)
+    dist = jnp.where(prev_valid[:, :, None], dist, jnp.inf)
+    match = jnp.argmin(dist, axis=1)                         # (B, new)
+    has_match = (jnp.isfinite(jnp.min(dist, axis=1)) & det_valid)
+    m_boxes = jnp.take_along_axis(prev_boxes, match[..., None], axis=1)
+    m_age = jnp.take_along_axis(slates["age"], match, axis=1)
+
+    blend = ema * sel_boxes + (1.0 - ema) * m_boxes
+    new_boxes = jnp.where(has_match[..., None], blend, sel_boxes)
+    velocity = jnp.where(has_match[..., None], new_boxes - m_boxes, 0.0)
+    new_scores = jnp.maximum(sel_scores, 0.0)
+    age = jnp.where(has_match, m_age + 1.0, 0.0)
+
+    new_slates = {"boxes": new_boxes, "velocity": velocity,
+                  "scores": new_scores, "age": age}
+    out = {"boxes": new_boxes, "scores": new_scores,
+           "tracked": new_scores > 0.0}
+    return new_slates, out
+
+
+def _track_advance(slates, *, damp: float, decay: float):
+    """Interpolation-frame program: constant-velocity propagation with
+    velocity damping and score decay. No detector, no host traffic."""
+    boxes = slates["boxes"] + slates["velocity"]
+    new_slates = {
+        "boxes": boxes,
+        "velocity": slates["velocity"] * damp,
+        "scores": slates["scores"] * decay,
+        "age": slates["age"] + 1.0,
+    }
+    out = {"boxes": boxes, "scores": new_slates["scores"],
+           "tracked": new_slates["scores"] > 0.0}
+    return new_slates, out
+
+
+class _TrackRunner:
+    """Per-(bucket, mesh) compiled programs for one TrackingPipeline:
+    ``detect`` (the stage forward), ``update`` (associate), ``advance``
+    (interpolate). Calling the runner directly runs the detect path on
+    a zero slate — that is what ``engine.warm()`` zero-executes."""
+
+    __slots__ = ("detect", "update", "advance", "bucket", "slots")
+
+    def __init__(self, detect, update, advance, bucket, slots):
+        self.detect = detect
+        self.update = update
+        self.advance = advance
+        self.bucket = bucket
+        self.slots = slots
+
+    def zero_slates(self):
+        return _zero_slate(self.slots, self.bucket)
+
+    def __call__(self, xd):
+        _, out = self.update(self.zero_slates(), self.detect(xd))
+        return out
+
+
+class TrackingPipeline:
+    """Tracking-by-detection as a stateful DAG on PR 18's stages.
+
+    Wraps a detect-task :class:`~.models.ServedModel`: the detector
+    stage runs every ``detect_every``-th frame of each stream (and on
+    any frame where the stream has no state yet); frames in between run
+    the compiled ``advance`` program only. The per-stream slate lives
+    in ``store`` (a :class:`SessionStore`), threaded through the
+    engine's existing admission/deadline path via ``session``/``seq``
+    on submit.
+
+    Duck-types the ServedModel surface the engine consumes (``name``,
+    ``input_shape``, ``dtype_str``, ``buckets``, ``compile_for``,
+    ``postprocess``) plus ``is_stateful = True`` which routes dispatch
+    to the stateful batch path.
+    """
+
+    is_pipeline = False
+    is_stateful = True
+    task = "track"
+    precompiled = None
+    scale = "unit"
+
+    def __init__(self, name: str, detector, store: SessionStore, *,
+                 detect_every: int = 4, slots: int = 4, ema: float = 0.5,
+                 damp: float = 0.9, decay: float = 0.9):
+        from deepvision_tpu.serve.pipeline import PipelineError
+
+        self.name = name
+        self.detector = detector
+        self.store = store
+        self.detect_every = max(1, int(detect_every))
+        self.slots = int(slots)
+        self.ema = float(ema)
+        self.damp = float(damp)
+        self.decay = float(decay)
+        self._stage = detector.as_stage()
+        if getattr(detector, "task", None) != "detect":
+            raise PipelineError(
+                f"TrackingPipeline {name!r} needs a detect-task model, "
+                f"got task {getattr(detector, 'task', None)!r}")
+
+    # -- ServedModel surface ----------------------------------------------
+    @property
+    def input_shape(self):
+        return self.detector.input_shape
+
+    @property
+    def input_dtype(self):
+        return self.detector.input_dtype
+
+    @property
+    def dtype_str(self) -> str:
+        return self.detector.dtype_str
+
+    @property
+    def buckets(self):
+        return self.detector.buckets
+
+    @property
+    def variables(self):
+        return None
+
+    def stage_models(self) -> dict:
+        """The stage map the engine replicates variables for (same
+        contract as Pipeline.stage_models)."""
+        return {"detector": self._stage}
+
+    def compile_for(self, bucket: int, mesh) -> _TrackRunner:
+        """AOT-compile detect/update/advance at ``bucket`` and validate
+        the detector's output contract via its avals (no FLOPs)."""
+        import functools
+
+        import jax
+
+        from deepvision_tpu.serve.pipeline import PipelineError
+
+        out = self._stage.out_avals(bucket)
+        need = ("boxes", "scores", "valid")
+        if not isinstance(out, dict) or any(k not in out for k in need):
+            have = sorted(out) if isinstance(out, dict) else type(out)
+            raise PipelineError(
+                f"tracking detector {self._stage.name!r} must emit a "
+                f"detect-style dict with keys {need}, got {have}")
+        detect = self._stage.compile(bucket, mesh, donate=True)
+        slate_avals = {
+            name: jax.ShapeDtypeStruct((bucket, *shape), dtype)
+            for name, (shape, dtype) in slate_spec(self.slots).items()}
+        det_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in out.items()}
+        upd = functools.partial(_track_update, slots=self.slots,
+                                ema=self.ema)
+        adv = functools.partial(_track_advance, damp=self.damp,
+                                decay=self.decay)
+        update = jax.jit(upd).lower(slate_avals, det_avals).compile()
+        advance = jax.jit(adv).lower(slate_avals).compile()
+        return _TrackRunner(detect, update, advance, bucket, self.slots)
+
+    def postprocess(self, host: dict, i: int) -> dict:
+        """Per-row result from the fetched batch output. Deterministic
+        fields only — the engine merges session/seq/state_reset in."""
+        return {
+            "boxes": np.asarray(host["boxes"][i]).tolist(),
+            "scores": np.asarray(host["scores"][i]).tolist(),
+            "tracked": np.asarray(host["tracked"][i]).astype(bool).tolist(),
+        }
+
+
+# ------------------------------------------------- synthetic detector
+
+def synthetic_detector(name: str = "synth", size: int = 16,
+                       channels: int = 1, candidates: int = 8):
+    """A weight-free detect-task ServedModel for stream drills: boxes
+    derive from per-quadrant image moments — device-computed, fully
+    deterministic, compiles in milliseconds. The chaos drill's
+    determinism pin (fault run outputs == fault-free twin) leans on
+    this plus the bit-exact snapshot round trip."""
+    from deepvision_tpu.serve.models import ServedModel
+
+    def forward(variables, x):
+        import jax.numpy as jnp
+
+        b = x.shape[0]
+        # quadrant means -> candidate box geometry; any fixed pure
+        # function of the frame works, moments keep it smooth
+        flat = x.reshape(b, -1)
+        n = flat.shape[1]
+        k = candidates
+        chunk = max(1, n // k)
+        means = jnp.stack(
+            [flat[:, i * chunk:(i + 1) * chunk].mean(axis=1)
+             for i in range(k)], axis=1)                     # (B, k)
+        frac = (jnp.tanh(means) + 1.0) * 0.5                 # (0, 1)
+        idx = jnp.arange(k, dtype=jnp.float32) / k
+        x1 = jnp.clip(frac * 0.5 + idx[None, :] * 0.25, 0.0, 0.9)
+        y1 = jnp.clip(frac * 0.25 + idx[None, :] * 0.5, 0.0, 0.9)
+        wh = 0.05 + frac * 0.1
+        boxes = jnp.stack(
+            [x1, y1, jnp.clip(x1 + wh, 0.0, 1.0),
+             jnp.clip(y1 + wh, 0.0, 1.0)], axis=-1)          # (B, k, 4)
+        scores = 0.2 + 0.8 * frac
+        return {"boxes": boxes, "scores": scores,
+                "classes": jnp.zeros_like(scores, dtype=jnp.int32),
+                "valid": scores > 0.25}
+
+    def post(host, i):
+        keep = np.asarray(host["valid"][i]).astype(bool)
+        return {"boxes": np.asarray(host["boxes"][i])[keep].tolist(),
+                "scores": np.asarray(host["scores"][i])[keep].tolist()}
+
+    return ServedModel(name=name, task="detect", forward=forward,
+                       variables={}, input_shape=(size, size, channels),
+                       postprocess=post)

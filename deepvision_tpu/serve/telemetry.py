@@ -212,6 +212,11 @@ _ROUTER_COUNTER_FIELDS = (
     "replica_restarts",   # replicas respawned by the supervisor
     "scale_ups",          # autoscaler added a replica
     "scale_downs",        # autoscaler drained a replica
+    "sessions_migrated",  # stateful streams re-pinned to a survivor
+                          # after their pinned replica died
+    "session_resets",     # stream responses that DECLARED state loss
+                          # (state_reset=true) — the honesty counter
+                          # the chaos drill gates at zero
 )
 
 
@@ -283,7 +288,9 @@ class RouterTelemetry:
                 f"hedges={v['hedges']} deaths={v['replica_deaths']} "
                 f"restarts={v['replica_restarts']} "
                 f"sheds={v['sheds_total']} completed={v['completed']} "
-                f"failed={v['failed']}")
+                f"failed={v['failed']} "
+                f"sessions_migrated={v['sessions_migrated']} "
+                f"resets={v['session_resets']}")
 
 
 for _f in _ROUTER_COUNTER_FIELDS:

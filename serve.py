@@ -76,8 +76,9 @@ def build_engine(args):
                 name, path = None, name
             models.append(from_stablehlo(path, name=name,
                                          top_k=args.top))
-    if not models:
-        sys.exit("no models: pass -m NAME[=WORKDIR] or --artifact")
+    if not models and not getattr(args, "track", None):
+        sys.exit("no models: pass -m NAME[=WORKDIR], --artifact, "
+                 "or --track")
     buckets = tuple(int(b) for b in args.buckets.split(","))
     mesh, buckets = _serving_mesh(buckets)
     pipelines = []
@@ -94,16 +95,52 @@ def build_engine(args):
                 # before any compile — a bad spec kills startup, not a
                 # request
                 pipelines.append(Pipeline(spec, by_name))
-    print(f"serving {[m.name for m in models]}"
-          f"{' pipelines ' + str([p.name for p in pipelines]) if pipelines else ''}"
-          f" buckets={buckets} on {mesh.devices.size} device(s); "
-          "compiling...", file=sys.stderr)
     injector = None
     if args.faults:
         from deepvision_tpu.resilience import FaultInjector
 
         injector = FaultInjector(args.faults, seed=args.fault_seed)
         print(f"fault injection armed: {args.faults!r}", file=sys.stderr)
+    if getattr(args, "track", None):
+        # stateful tracking stream: --track MODEL[:K] serves a
+        # TrackingPipeline named "track" over detect-model MODEL
+        # ("synth" builds the weight-free synthetic detector). Session
+        # state is device-resident per stream; crash-safe snapshots
+        # land under --session-dir so a respawned/surviving replica
+        # restores migrated streams.
+        import tempfile
+
+        from deepvision_tpu.serve.sessions import (
+            SessionStore,
+            TrackingPipeline,
+            synthetic_detector,
+        )
+
+        det_name, _, k = args.track.partition(":")
+        by_name = {m.name: m for m in models}
+        if det_name in by_name:
+            det = by_name[det_name]
+        elif det_name == "synth":
+            det = synthetic_detector()
+            models.append(det)
+        else:
+            sys.exit(f"--track {args.track!r}: no model named "
+                     f"{det_name!r} (pass -m, or use 'synth')")
+        sdir = args.session_dir or tempfile.mkdtemp(
+            prefix="dvtpu-sessions-")
+        print(f"session snapshots -> {sdir} "
+              f"(cadence {args.snapshot_every} frames)", file=sys.stderr)
+        store = SessionStore(
+            capacity=args.session_capacity, ttl_s=args.session_ttl_s,
+            snapshot_dir=sdir, snapshot_every=args.snapshot_every,
+            injector=injector)
+        models.append(TrackingPipeline(
+            "track", det, store,
+            detect_every=int(k) if k else 4))
+    print(f"serving {[m.name for m in models]}"
+          f"{' pipelines ' + str([p.name for p in pipelines]) if pipelines else ''}"
+          f" buckets={buckets} on {mesh.devices.size} device(s); "
+          "compiling...", file=sys.stderr)
     engine = InferenceEngine(
         models, mesh=mesh, buckets=buckets, max_queue=args.max_queue,
         per_model_limit=args.per_model_limit,
@@ -152,8 +189,21 @@ def build_fleet(args):
     from deepvision_tpu.serve.replica import ProcessReplica, replica_argv
     from deepvision_tpu.serve.router import AutoscaleConfig, FleetRouter
 
-    if not (args.model or args.artifact):
-        sys.exit("no models: pass -m NAME[=WORKDIR] or --artifact")
+    if not (args.model or args.artifact or args.track):
+        sys.exit("no models: pass -m NAME[=WORKDIR], --artifact, "
+                 "or --track")
+    session_dir = None
+    if args.track:
+        # replicas must SHARE the snapshot dir: on a replica death the
+        # router re-pins orphaned streams to a survivor, which restores
+        # each stream's slate from the newest snapshot the dead replica
+        # wrote here
+        import tempfile
+
+        session_dir = args.session_dir or tempfile.mkdtemp(
+            prefix="dvtpu-sessions-")
+        print(f"session snapshots (shared across replicas) -> "
+              f"{session_dir}", file=sys.stderr)
     child_argv = replica_argv(
         args.model or [], artifact_specs=args.artifact or [],
         buckets=args.buckets,
@@ -165,6 +215,11 @@ def build_fleet(args):
            "--timeout-s", str(args.timeout_s)]
         + [a for path in (args.pipelines or [])
            for a in ("--pipelines", path)]
+        + (["--track", args.track, "--session-dir", session_dir,
+            "--session-capacity", str(args.session_capacity),
+            "--session-ttl-s", str(args.session_ttl_s),
+            "--snapshot-every", str(args.snapshot_every)]
+           if args.track else [])
         + (["--trace-spool", args.trace_spool]
            if args.trace_spool else []))
 
@@ -201,6 +256,13 @@ def build_fleet(args):
 
         models += [spec.name for path in args.pipelines
                    for spec in load_pipeline_specs(path)]
+    if args.track:
+        # the tracking pipeline (and, for "synth", its generated
+        # detector) are routable names each replica builds itself
+        models.append("track")
+        det_name = args.track.partition(":")[0]
+        if det_name not in models:
+            models.append(det_name)
     print(f"starting fleet of {args.fleet} replica(s) "
           f"({models or args.artifact}); replicas compile in "
           "parallel...", file=sys.stderr)
@@ -209,6 +271,7 @@ def build_fleet(args):
         default_deadline_s=args.timeout_s, max_queue=args.max_queue,
         per_model_limit=args.per_model_limit, autoscale=autoscale,
         hedge_after_s=args.hedge_after, fault_injector=injector,
+        session_replay_window=args.session_replay_window,
     )
     print(f"fleet up: {router.health()}", file=sys.stderr)
     return router
@@ -303,6 +366,9 @@ def run_stdin(engine, args, stdin=None, stdout=None):
             if not isinstance(req, dict):
                 raise ValueError("request must be a JSON object")
             x = np.asarray(req["input"], np.float32)
+            # stateful streams: session (stream id) + seq (frame no.)
+            seq = req.get("seq")
+            seq = int(seq) if seq is not None else None
         except (ValueError, KeyError, TypeError) as e:
             print(json.dumps({"error": f"bad request: {e}"}),
                   file=stdout, flush=True)
@@ -315,7 +381,8 @@ def run_stdin(engine, args, stdin=None, stdout=None):
             fut = engine.submit(x, model=(req.get("model")
                                           or req.get("pipeline")),
                                 timeout_s=args.timeout_s,
-                                trace=req.get("trace"))
+                                trace=req.get("trace"),
+                                session=req.get("session"), seq=seq)
         except ShedError as e:
             print(json.dumps({"id": rid, "error": str(e),
                               "retry_after": e.retry_after_s}),
@@ -444,6 +511,11 @@ def make_handler(engine, args):
                 if not isinstance(req, dict):
                     raise ValueError("request must be a JSON object")
                 x = _decode_input(req)
+                # stateful streams (the fleet router forwards these on
+                # its replica hop): session = stream id, seq = frame
+                session = req.get("session")
+                seq = req.get("seq")
+                seq = int(seq) if seq is not None else None
                 # per-request deadline (the fleet router forwards its
                 # remaining budget here); the CLI blanket is a CEILING
                 timeout_s = args.timeout_s
@@ -467,7 +539,8 @@ def make_handler(engine, args):
                     x,
                     model=(pipeline or req.get("model")
                            or req.get("pipeline")),
-                    timeout_s=timeout_s, trace=trace)
+                    timeout_s=timeout_s, trace=trace,
+                    session=session, seq=seq)
                 result = fut.result(timeout=timeout_s + 1.0)
             except ShedError as e:
                 self._send(429, {"error": str(e),
@@ -618,6 +691,35 @@ def main(argv=None):
                         "running a padded partial batch")
     p.add_argument("--timeout-s", type=float, default=30.0,
                    help="per-request deadline")
+    p.add_argument("--track", default=None, metavar="MODEL[:K]",
+                   help="serve a stateful tracking-by-detection stream "
+                        "named 'track' over detect-model MODEL "
+                        "('synth' builds a weight-free synthetic "
+                        "detector); the detector runs every K-th frame "
+                        "(default 4), frames between run the compiled "
+                        "advance program on the stream's device-"
+                        "resident slate. Requests address it with "
+                        "{'model': 'track', 'session': ID, 'seq': N}")
+    p.add_argument("--session-dir", default=None, metavar="DIR",
+                   help="crash-safe session snapshot directory "
+                        "(default: auto tempdir; fleet mode shares one "
+                        "dir across replicas so a migrated stream "
+                        "restores on the survivor)")
+    p.add_argument("--session-capacity", type=int, default=64,
+                   help="max live sessions per engine; NEW sessions "
+                        "are shed at submit when full — existing "
+                        "pinned state is never dropped for a newcomer")
+    p.add_argument("--session-ttl-s", type=float, default=300.0,
+                   help="idle seconds before a session is evicted "
+                        "(dirty state snapshots first)")
+    p.add_argument("--snapshot-every", type=int, default=8,
+                   help="incremental session snapshot cadence in "
+                        "frames (bounds replay work after a crash)")
+    p.add_argument("--session-replay-window", type=int, default=32,
+                   help="fleet mode: frames the router buffers per "
+                        "stream to replay the snapshot->present gap "
+                        "after a failover; a gap wider than this "
+                        "degrades to a DECLARED state_reset")
     p.add_argument("--num-classes", type=int, default=None)
     p.add_argument("--top", type=int, default=5)
     p.add_argument("--score", type=float, default=0.5)

@@ -2074,6 +2074,133 @@ def test_load_config_reads_pipeline_funcs(tmp_path):
     assert "*pipeline*" in LintConfig().pipeline_funcs
 
 
+# ----------------------------------------------------------- JX128
+
+
+def test_jx128_flags_per_frame_host_fetch(tmp_path):
+    r = lint(tmp_path, "serve/stream.py", """
+        import jax
+        import numpy as np
+
+        def handle_stream(frames, store, sid):
+            for seq, x in enumerate(frames):
+                state = store.state(sid)
+                host = jax.device_get(state)      # per-frame: flagged
+                boxes = np.asarray(state["boxes"])  # flagged
+                n = state["scores"].sum().item()  # flagged
+                yield host, boxes, n
+        """)
+    assert codes(r) == ["JX128", "JX128", "JX128"]
+    assert "device-resident" in r.findings[0].message
+
+
+def test_jx128_flags_helper_routed_sync(tmp_path):
+    # the fetch hides inside a helper the frame loop calls — the
+    # project blocking-callable summary routes the finding through
+    r = lint(tmp_path, "serve/stream.py", """
+        import numpy as np
+
+        def _slate_to_host(state):
+            return np.asarray(state)
+
+        def frame_loop(frames, state):
+            for x in frames:
+                state = advance(state, x)
+                log = _slate_to_host(state)
+            return state
+        """)
+    assert codes(r) == ["JX128"]
+    assert "_slate_to_host" in r.findings[0].message
+
+
+def test_jx128_passes_device_resident_loop(tmp_path):
+    # clean stream loop: state flows frame to frame as device arrays;
+    # the single fetch lives outside the loop (the engine contract)
+    r = lint(tmp_path, "serve/stream.py", """
+        import jax
+
+        def handle_stream(frames, state):
+            for x in frames:
+                state = advance(state, x)
+            return jax.device_get(state)
+        """)
+    assert codes(r) == []
+
+
+def test_jx128_fetch_outside_loop_not_flagged(tmp_path):
+    # a matching function with host fetches but NO loop around them
+    # (e.g. the store's snapshot path shape) is not a per-frame hazard
+    r = lint(tmp_path, "serve/stream.py", """
+        import jax
+
+        def stream_loop_snapshot(state, path):
+            host = jax.device_get(state)
+            path.write_bytes(encode(host))
+        """)
+    assert codes(r) == []
+
+
+def test_jx128_nested_def_not_charged_to_parent(tmp_path):
+    # the fetch sits in a nested non-matching closure (a completion
+    # callback built per frame) — own-body scoping must not charge
+    # the matching parent for it
+    r = lint(tmp_path, "serve/stream.py", """
+        import numpy as np
+
+        def handle_stream(frames, submit):
+            for x in frames:
+                def on_done(fut):
+                    return np.asarray(fut.result())
+                submit(x, on_done)
+        """)
+    assert codes(r) == []
+
+
+def test_jx128_session_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(session_funcs=["drive_cameras*"])
+    r = lint(tmp_path, "lib/cams.py", """
+        import jax
+
+        def drive_cameras(frames, state):
+            for x in frames:
+                state = jax.device_get(advance(state, x))  # matched
+            return state
+
+        def handle_stream(frames, state):
+            for x in frames:
+                state = jax.device_get(advance(state, x))  # NOT matched
+            return state
+        """, cfg=cfg)
+    assert codes(r) == ["JX128"]
+
+
+def test_jx128_inline_suppression(tmp_path):
+    r = lint(tmp_path, "serve/stream.py", """
+        import jax
+
+        def handle_stream(frames, state, debug):
+            for x in frames:
+                state = advance(state, x)
+                if debug:
+                    print(jax.device_get(state))  # jaxlint: disable=JX128
+            return state
+        """)
+    assert codes(r) == []
+
+
+def test_load_config_reads_session_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        session_funcs = ["drive_cameras*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.session_funcs == ["drive_cameras*"]
+    assert "*frame_loop*" in LintConfig().session_funcs
+
+
 # ------------------------------- concurrency tier (ISSUE 14, JX118-122)
 
 

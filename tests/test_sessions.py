@@ -1,0 +1,402 @@
+"""Crash-safe stateful sessions (serve/sessions.py + the engine's
+stateful batch path + the router's session affinity): snapshot
+round-trip bit-equality, TTL eviction vs capacity shedding (existing
+state is never dropped for a newcomer), corrupt-snapshot quarantine +
+fallback, declared (never silent) resets, the engine's
+one-frame-per-session batch dedupe, and sticky routing with in-order
+delivery through a replica kill + failover.
+
+Store-level tests run with plain numpy state rows (no compiles at
+all); engine/fleet tests use the weight-free synthetic detector
+(millisecond compiles) so the whole matrix stays in the fast tier.
+The full SIGKILL drill is `bench.py streams` / `make stream-smoke`.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from deepvision_tpu.serve import ShedError  # noqa: E402
+from deepvision_tpu.serve.sessions import (  # noqa: E402
+    SessionStore,
+    TrackingPipeline,
+    synthetic_detector,
+)
+
+# ------------------------------------------------------------- fixtures
+
+
+def _state_row(rng, slots=4):
+    return {
+        "boxes": rng.normal(size=(slots, 4)).astype(np.float32),
+        "velocity": rng.normal(size=(slots, 4)).astype(np.float32),
+        "scores": rng.uniform(size=(slots,)).astype(np.float32),
+        "age": rng.integers(0, 9, size=(slots,)).astype(np.float32),
+    }
+
+
+def _drive(store, sid, seqs, rng, detect_every=4):
+    """Admit + run the frame protocol for ``seqs``, committing a fresh
+    random state row per applied frame; returns the last row."""
+    row = None
+    store.admit(sid)
+    for seq in seqs:
+        f = store.begin_frame(sid, seq, detect_every)
+        if f.action == "apply":
+            row = _state_row(rng)
+            store.commit(sid, seq, row)
+    return row
+
+
+def tracking_engine(snap_dir, **store_kw):
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.serve import InferenceEngine
+
+    det = synthetic_detector()
+    store = SessionStore(snapshot_dir=snap_dir, **store_kw)
+    track = TrackingPipeline("track", det, store, detect_every=4)
+    eng = InferenceEngine([det, track], mesh=create_mesh(1, 1),
+                          buckets=(4,), batch_window_s=0.002)
+    return eng, store
+
+
+def stream_fleet(snap_dir, n=2):
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.obs.metrics import Registry
+    from deepvision_tpu.serve import EngineReplica, FleetRouter
+    from deepvision_tpu.serve.telemetry import RouterTelemetry
+
+    def factory(sid):
+        def build():
+            det = synthetic_detector()
+            store = SessionStore(snapshot_dir=snap_dir, snapshot_every=3)
+            return [det, TrackingPipeline("track", det, store,
+                                          detect_every=4)]
+
+        return EngineReplica(sid, build, mesh=create_mesh(1, 1),
+                             buckets=(4,))
+
+    return FleetRouter(factory, replicas=n, models=["synth", "track"],
+                       max_queue=256, default_deadline_s=60.0,
+                       telemetry=RouterTelemetry(registry=Registry()))
+
+
+def frame(rng):
+    return rng.normal(scale=0.3, size=(16, 16, 1)).astype(np.float32)
+
+
+# ------------------------------------------------- store: snapshots
+
+
+def test_snapshot_round_trip_bit_equality(tmp_path):
+    rng = np.random.default_rng(0)
+    store = SessionStore(snapshot_dir=tmp_path, snapshot_every=10)
+    row = _drive(store, "s1", range(3), rng)
+    assert store.flush() == 1
+    snaps = sorted(tmp_path.glob("s1-*.snap.json"))
+    assert len(snaps) == 1
+    ok, reason = SessionStore.verify_snapshot(snaps[0])
+    assert ok, reason
+    seq, host = SessionStore.load_snapshot(snaps[0])
+    assert seq == 2
+    # raw-byte b64 leaves: the round trip must be BIT-exact (the
+    # chaos drill's determinism pin leans on this)
+    assert sorted(host) == sorted(row)
+    for k in row:
+        assert host[k].dtype == row[k].dtype
+        assert host[k].tobytes() == row[k].tobytes()
+
+
+def test_snapshot_cadence_and_pruning(tmp_path):
+    rng = np.random.default_rng(1)
+    store = SessionStore(snapshot_dir=tmp_path, snapshot_every=2,
+                         keep_snapshots=2)
+    _drive(store, "s1", range(9), rng)
+    snaps = sorted(tmp_path.glob("s1-*.snap.json"))
+    # cadence wrote at seq 1,3,5,7; pruning keeps the newest 2
+    assert len(snaps) == 2
+    assert store.stats()["counters"]["snapshots"] == 4
+
+
+def test_restore_resumes_without_reset(tmp_path):
+    rng = np.random.default_rng(2)
+    store = SessionStore(snapshot_dir=tmp_path, snapshot_every=2)
+    _drive(store, "s1", range(4), rng)
+    store.flush()
+    # fresh store over the same dir = fresh process after a crash
+    store2 = SessionStore(snapshot_dir=tmp_path)
+    store2.admit("s1")
+    f = store2.begin_frame("s1", 4, 4)
+    assert f.action == "apply" and f.restored and not f.reset
+    # a duplicate of the snapshotted frame is answered, not re-run
+    dup = store2.begin_frame("s1", 3, 4)
+    assert dup.action == "duplicate"
+    assert store2.stats()["counters"]["restores"] == 1
+
+
+def test_corrupt_snapshot_quarantined_with_fallback(tmp_path):
+    rng = np.random.default_rng(3)
+    store = SessionStore(snapshot_dir=tmp_path, snapshot_every=2,
+                         keep_snapshots=2)
+    _drive(store, "s1", range(6), rng)
+    snaps = sorted(tmp_path.glob("s1-*.snap.json"))
+    assert len(snaps) == 2
+    snaps[-1].write_bytes(b"\x00garbage\x00")  # torn/garbled newest
+    store2 = SessionStore(snapshot_dir=tmp_path)
+    store2.admit("s1")
+    f = store2.begin_frame("s1", 6, 4)
+    # restore fell back to the older verified snapshot -> the gap to
+    # seq 6 is DECLARED, never silent
+    assert f.restored and f.reset
+    c = store2.stats()["counters"]
+    assert c["snapshot_corrupt"] == 1 and c["restores"] == 1
+    assert list(tmp_path.glob("*.json.corrupt")), "corrupt file kept"
+
+
+def test_all_snapshots_corrupt_declares_reset(tmp_path):
+    rng = np.random.default_rng(4)
+    store = SessionStore(snapshot_dir=tmp_path, snapshot_every=2,
+                         keep_snapshots=1)
+    _drive(store, "s1", range(4), rng)
+    for p in tmp_path.glob("s1-*.snap.json"):
+        p.write_bytes(b"nope")
+    store2 = SessionStore(snapshot_dir=tmp_path)
+    store2.admit("s1")
+    f = store2.begin_frame("s1", 4, 4)
+    assert not f.restored and f.reset
+    assert store2.stats()["counters"]["resets"] == 1
+
+
+# ----------------------------------- store: admission + frame protocol
+
+
+def test_capacity_sheds_new_sessions_not_old_state(tmp_path):
+    rng = np.random.default_rng(5)
+    store = SessionStore(capacity=2, ttl_s=300.0, snapshot_dir=tmp_path)
+    _drive(store, "a", range(2), rng)
+    _drive(store, "b", range(2), rng)
+    with pytest.raises(ShedError) as exc:
+        store.admit("c")
+    assert exc.value.retry_after_s > 0
+    st = store.stats()
+    assert st["live"] == 2  # a and b keep their pinned state
+    assert st["counters"]["shed_capacity"] == 1
+    # existing sessions still admit (touch) fine at capacity
+    store.admit("a")
+
+
+def test_ttl_eviction_frees_capacity_and_snapshots_dirty_state(
+        tmp_path, monkeypatch):
+    rng = np.random.default_rng(6)
+    store = SessionStore(capacity=2, ttl_s=10.0, snapshot_dir=tmp_path,
+                         snapshot_every=100)
+    _drive(store, "a", range(3), rng)
+    _drive(store, "b", range(1), rng)
+    clock = {"t": store._now()}
+    monkeypatch.setattr(store, "_now", lambda: clock["t"])
+    clock["t"] += 11.0  # both sessions idle past the TTL
+    store.admit("c")  # eviction runs first, so this is NOT shed
+    st = store.stats()
+    assert st["counters"]["evicted_ttl"] == 2
+    assert st["live"] == 1
+    # the dirty evictees were snapshotted on the way out: they resume
+    # (restored), they don't reset
+    f = store.begin_frame("a", 3, 4)
+    assert f.restored and not f.reset
+
+
+def test_seq_gap_declares_reset_and_duplicates_dedupe(tmp_path):
+    rng = np.random.default_rng(7)
+    store = SessionStore(snapshot_dir=tmp_path)
+    _drive(store, "s", range(2), rng)
+    dup = store.begin_frame("s", 1, 4)
+    assert dup.action == "duplicate" and not dup.reset
+    gap = store.begin_frame("s", 5, 4)  # frames 2-4 lost
+    assert gap.action == "apply" and gap.reset
+    c = store.stats()["counters"]
+    assert c["duplicates"] == 1 and c["resets"] == 1
+
+
+def test_abandon_drops_state_but_keeps_snapshots(tmp_path):
+    rng = np.random.default_rng(8)
+    store = SessionStore(snapshot_dir=tmp_path, snapshot_every=2)
+    _drive(store, "s", range(4), rng)
+    n_snaps = len(list(tmp_path.glob("s-*.snap.json")))
+    assert n_snaps > 0
+    store.abandon()  # crash semantics: no flush
+    assert store.stats()["live"] == 0
+    assert len(list(tmp_path.glob("s-*.snap.json"))) == n_snaps
+
+
+def test_pinned_bytes_and_snapshot_age(tmp_path):
+    rng = np.random.default_rng(9)
+    store = SessionStore(snapshot_dir=tmp_path, snapshot_every=2)
+    assert store.pinned_bytes() == 0 and store.snapshot_age_s() is None
+    _drive(store, "s", range(3), rng)
+    # 4 slots x (4+4+1+1) f32 = 40 floats = 160 bytes
+    assert store.pinned_bytes() == 160
+    assert store.snapshot_age_s() is not None
+
+
+# ------------------------------------------------- engine: stateful path
+
+
+def test_engine_stateful_stream_in_order(tmp_path):
+    rng = np.random.default_rng(10)
+    eng, store = tracking_engine(tmp_path, snapshot_every=3)
+    try:
+        futs = [eng.submit(frame(rng), model="track", session="s1",
+                           seq=i) for i in range(8)]
+        for i, f in enumerate(futs):
+            r = f.result(timeout=60)
+            assert r["session"] == "s1" and r["seq"] == i
+            assert r["state_reset"] is False
+            # detect on every 4th frame AND on frame 0 (no state yet)
+            assert r["detected"] == (i % 4 == 0)
+            assert len(r["boxes"]) == 4  # slots
+        # duplicate frame answered idempotently, not re-executed
+        dup = eng.submit(frame(rng), model="track", session="s1",
+                         seq=3).result(timeout=60)
+        assert dup["replayed"] is True and dup["state_reset"] is False
+        h = eng.health()["sessions"]
+        assert h["live"] == 1 and h["pinned_bytes"] == 160
+        assert eng.stats()["sessions"]["track"]["counters"]["opened"] == 1
+    finally:
+        eng.close()
+
+
+def test_engine_batch_dedupes_same_session_frames(tmp_path):
+    # frames of ONE stream submitted together must execute serially
+    # (state threads frame to frame), while still resolving in order
+    rng = np.random.default_rng(11)
+    eng, store = tracking_engine(tmp_path)
+    try:
+        done = []
+        lock = threading.Lock()
+        futs = []
+        for i in range(6):
+            fut = eng.submit(frame(rng), model="track", session="s1",
+                             seq=i)
+            fut.add_done_callback(
+                lambda f, i=i: (lock.__enter__(), done.append(i),
+                                lock.__exit__(None, None, None)))
+            futs.append(fut)
+        for f in futs:
+            f.result(timeout=60)
+        assert done == list(range(6))
+        c = store.stats()["counters"]
+        assert c["duplicates"] == 0 and c["resets"] == 0
+    finally:
+        eng.close()
+
+
+def test_engine_rejects_malformed_stateful_submits(tmp_path):
+    rng = np.random.default_rng(12)
+    eng, _ = tracking_engine(tmp_path)
+    try:
+        with pytest.raises(ValueError, match="requires session"):
+            eng.submit(frame(rng), model="track")
+        with pytest.raises(ValueError, match="stateless"):
+            eng.submit(frame(rng), model="synth", session="s", seq=0)
+    finally:
+        eng.close()
+
+
+def test_engine_close_flushes_then_fresh_engine_restores(tmp_path):
+    rng = np.random.default_rng(13)
+    xs = [frame(rng) for _ in range(5)]
+    eng, _ = tracking_engine(tmp_path, snapshot_every=100)
+    try:
+        for i, x in enumerate(xs[:4]):
+            eng.submit(x, model="track", session="s1",
+                       seq=i).result(timeout=60)
+    finally:
+        eng.close()  # graceful: flushes the dirty slate
+    eng2, store2 = tracking_engine(tmp_path, snapshot_every=100)
+    try:
+        r = eng2.submit(xs[4], model="track", session="s1",
+                        seq=4).result(timeout=60)
+        assert r["state_reset"] is False  # resumed, not reset
+        assert store2.stats()["counters"]["restores"] == 1
+    finally:
+        eng2.close()
+
+
+# --------------------------------------------- router: session affinity
+
+
+def test_sticky_routing_survives_kill_with_ordering(tmp_path):
+    rng = np.random.default_rng(14)
+    router = stream_fleet(tmp_path)
+    try:
+        xs = {s: [frame(rng) for _ in range(12)] for s in ("sA", "sB")}
+        done: dict[str, list[int]] = {"sA": [], "sB": []}
+        lock = threading.Lock()
+        outs = {}
+
+        def submit(s, i):
+            fut = router.submit(xs[s][i], model="track", session=s,
+                                seq=i)
+
+            def cb(f, s=s, i=i):
+                with lock:
+                    done[s].append(i)
+
+            fut.add_done_callback(cb)
+            return (s, i, fut)
+
+        futs = [submit(s, i) for i in range(6) for s in ("sA", "sB")]
+        for s, i, f in futs:
+            outs[(s, i)] = f.result(timeout=60)
+        pins = router.stats()["sessions"]["pins"]
+        assert set(pins) == {"sA", "sB"}
+        # kill a replica that owns at least one pin: its streams must
+        # migrate, replay, and continue without a reset
+        with router._lock:
+            by_sid = {sl.sid: sl for sl in router._slots
+                      if sl.state == "ready"}
+        victim = by_sid[sorted(set(pins.values()))[0]]
+        victim.replica.kill()
+        futs = [submit(s, i) for i in range(6, 12) for s in ("sA", "sB")]
+        for s, i, f in futs:
+            outs[(s, i)] = f.result(timeout=60)
+        # every frame answered, in per-stream order, zero resets
+        for s in ("sA", "sB"):
+            assert done[s] == list(range(12))
+        assert not any(r.get("state_reset") for r in outs.values())
+        t = router.telemetry
+        assert t.sessions_migrated >= 1
+        assert t.session_resets == 0
+        assert "sessions_migrated=" in t.summary_line()
+        assert router.stats()["sessions"]["live"] == 2
+    finally:
+        router.close()
+
+
+def test_router_requires_seq_ordering_per_stream_fifo(tmp_path):
+    # frames submitted back-to-back (no waiting) drain FIFO per stream
+    rng = np.random.default_rng(15)
+    router = stream_fleet(tmp_path, n=1)
+    try:
+        order = []
+        lock = threading.Lock()
+        futs = []
+        for i in range(8):
+            fut = router.submit(frame(rng), model="track", session="s",
+                                seq=i)
+            fut.add_done_callback(
+                lambda f, i=i: (lock.__enter__(), order.append(i),
+                                lock.__exit__(None, None, None)))
+            futs.append(fut)
+        for f in futs:
+            f.result(timeout=60)
+        assert order == list(range(8))
+    finally:
+        router.close()

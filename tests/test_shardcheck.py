@@ -152,9 +152,13 @@ spec = "replicated"
 
 def _toy_case(reshard: bool = False) -> IRCase:
     """A tiny real pjit train step: batch-sharded x, replicated params,
-    one gradient-free update. ``reshard`` adds per-example RNG — the
-    same non-partitionable-threefry mechanism that permutes key
-    counters across batch shards in the registry's dropout/GAN models."""
+    one gradient-free update. ``reshard`` adds a batch-axis halo shift
+    (jnp.roll over the sharded dim) — a structural cross-shard data
+    dependency GSPMD must lower as a collective-permute. (Per-example
+    RNG no longer serves as the probe: partitionable threefry —
+    core/__init__.py, repo-wide — shards key derivation with the batch,
+    which is exactly how the registry's ~9 RNG reshard waivers
+    retired.)"""
 
     def build(batch: int, precision=None):
         import jax
@@ -167,9 +171,7 @@ def _toy_case(reshard: bool = False) -> IRCase:
         def step_fn(state, b, key):
             x = b["x"]
             if reshard:
-                keys = jax.random.split(key, x.shape[0])
-                x = x + jax.vmap(
-                    lambda k: jax.random.normal(k, (4,)))(keys)
+                x = x + jnp.roll(x, 1, axis=0)
             loss = jnp.mean((x @ state["params"]) ** 2)
             return ({"params": state["params"] - 0.01 * loss},
                     {"loss": loss})
@@ -243,8 +245,8 @@ def test_implicit_reshard_detector_fires_and_waives():
                for f in rep["failures"])
     waived = ShardCheckConfig(rules=list(_COVER_ALL), reshard=[
         ReshardWaiver(model="toy", op="collective-permute",
-                      reason="per-example RNG under non-partitionable "
-                             "threefry")])
+                      reason="batch-axis halo shift; deliberate "
+                             "cross-shard dependency")])
     rep = check_case(_toy_case(reshard=True), waived, mesh_shape=(2, 1))
     assert rep["ok"], rep["failures"]
     assert any("reshard waived" in n for n in rep["notes"])
@@ -331,15 +333,18 @@ def test_shardcheck_lenet5_live_two_meshes():
     assert mesh_consistency(reps) == []
 
 
-def test_shardcheck_dcgan_live_waives_rng_permutes():
-    # the registry's measured implicit-reshard case: per-example RNG
-    # under non-partitionable threefry permutes key counters across
-    # batch shards — declared in [[shardcheck.reshard]], not silent
+def test_shardcheck_dcgan_live_clean_under_partitionable_threefry():
+    # the registry's FORMER implicit-reshard case: per-example RNG used
+    # to permute key counters across batch shards. Partitionable
+    # threefry (core/__init__.py, repo-wide) shards key derivation with
+    # the data, so dcgan now lowers to the pure data-parallel
+    # all-reduce set with no waiver in play — the clean state the
+    # retired [[shardcheck.reshard]] RNG rows predicted.
     cfg = load_shardcheck_config(REPO_TOML)
     rep = check_case(make_cases()["dcgan"], cfg, mesh_shape=(2, 1))
     assert rep["ok"], rep["failures"]
-    assert "collective-permute" in rep["collectives"]
-    assert any("reshard waived" in n for n in rep["notes"])
+    assert set(rep["collectives"]) == {"all-reduce"}
+    assert not any("reshard waived" in n for n in rep["notes"])
 
 
 def test_zero1_residency_reconciles_with_state_bytes():

@@ -97,6 +97,10 @@ Checker codes (tools/jaxlint/checkers.py):
            inter-stage value inside a pipeline execution path
            (``pipeline_funcs`` knob) — stage outputs must stay
            device-resident until the engine's single final fetch
+    JX128  jax.device_get/np.asarray/.item() inside the per-frame
+           loop of a stream-handling function (``session_funcs``
+           knob) — session state stays device-resident between
+           frames; the stateful batch path does ONE fetch per batch
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

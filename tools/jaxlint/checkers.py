@@ -1767,6 +1767,85 @@ class PipelineHostRoundTripChecker(Checker):
                         "engine's final fetch")
 
 
+@register_checker
+class SessionHostRoundTripChecker(Checker):
+    """Per-frame host round-trip on session state inside a
+    stream-handling loop: stateful serving (``serve/sessions.py``) pins
+    each stream's tracking slate on device between frames — the entire
+    point of the subsystem — and the engine's stateful batch path
+    performs exactly ONE ``device_get`` per executed batch. A
+    ``jax.device_get`` / ``np.asarray`` / ``.item()`` inside the
+    per-frame loop re-materializes the slate on the host every frame,
+    turning the device-resident design back into the
+    fetch-per-frame pipeline it replaced — results stay correct, only
+    the latency contract breaks, so nothing else catches it. Which
+    functions count as stream-handling loops is the ``session_funcs``
+    knob (name patterns, ``jaxlint.toml``); helper-routed syncs are
+    flagged through the project blocking-callable summary, same as
+    JX109/JX127. Snapshotting is exempt by scoping: the store's
+    snapshot path is cadence-driven host I/O, not a per-frame loop."""
+
+    code = "JX128"
+    name = "host-round-trip-in-stream-loop"
+    description = ("jax.device_get / np.asarray / .item(), direct or "
+                   "helper-routed, inside the per-frame loop of a "
+                   "stream-handling function (re-materializes "
+                   "device-resident session state every frame)")
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.session_funcs
+        for info in mod.functions:
+            if not any(fnmatch.fnmatch(info.node.name, p)
+                       for p in patterns):
+                continue
+            # own body only: a nested def is its own FunctionInfo and
+            # is matched (or not) on its own name
+            own = {id(n): n for n in iter_own_nodes(info.node)}
+            flagged: set[int] = set()  # nested loops: report once
+            for loop in own.values():
+                if not isinstance(loop,
+                                  (ast.For, ast.AsyncFor, ast.While)):
+                    continue
+                for stmt in loop.body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call) \
+                                or id(sub) not in own \
+                                or id(sub) in flagged:
+                            continue
+                        name = call_name(sub)
+                        method = (sub.func.attr
+                                  if isinstance(sub.func, ast.Attribute)
+                                  else None)
+                        if is_host_blocking_call(sub) \
+                                or method == "item":
+                            flagged.add(id(sub))
+                            label = name or f".{method}()"
+                            yield mod.finding(
+                                sub, self.code,
+                                f"'{label}' fetches session state to "
+                                "the host inside the per-frame loop of "
+                                f"'{info.node.name}': stream state must "
+                                "stay device-resident between frames — "
+                                "the engine's stateful batch path does "
+                                "ONE device_get per batch; move the "
+                                "fetch out of the loop (or to the "
+                                "snapshot cadence)")
+                            continue
+                        helper = mod.call_blocks_host(sub)
+                        if helper is not None:
+                            flagged.add(id(sub))
+                            yield mod.finding(
+                                sub, self.code,
+                                f"'{name or helper}' blocks the host "
+                                "inside the per-frame loop of "
+                                f"'{info.node.name}' (the helper "
+                                f"'{helper}' transitively calls "
+                                "np.asarray/block_until_ready/"
+                                "device_get): per-frame host round-"
+                                "trips re-introduce the fetch-per-frame "
+                                "pipeline the session store removes")
+
+
 # concurrency tier (JX118-JX122, ISSUE 14): importing for registration
 # side effects keeps every "import checkers" site (run_paths, the CLI)
 # seeing the full checker set

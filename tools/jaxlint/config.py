@@ -282,6 +282,18 @@ class LintConfig:
     pipeline_funcs: list[str] = field(default_factory=lambda: [
         "*pipeline*", "*_stage*", "run_dag*", "*_dag_*",
     ])
+    # Function-name patterns treated as stream-handling loops (JX128):
+    # stateful serving (serve/sessions.py) keeps each stream's session
+    # state device-resident between frames, and the engine's stateful
+    # batch path does exactly ONE device_get per executed batch — a
+    # jax.device_get / np.asarray / .item() inside the per-frame loop
+    # re-materializes the slate on the host every frame. The store's
+    # own snapshot path (cadence-driven host I/O) is exempt by scoping:
+    # it isn't a per-frame loop and these names don't match it.
+    session_funcs: list[str] = field(default_factory=lambda: [
+        "*frame_loop*", "*session_loop*", "handle_stream*",
+        "*stream_loop*", "serve_stream*",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -303,7 +315,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "prefetch_funcs", "serve_funcs", "checked_step_funcs",
         "timed_funcs", "loop_sleep_funcs", "wire_funcs",
         "cluster_funcs", "sentinel_funcs", "span_funcs",
-        "precision_funcs", "pipeline_funcs",
+        "precision_funcs", "pipeline_funcs", "session_funcs",
         "lock_name_patterns", "lock_blocking_calls", "collective_calls",
         "fork_unsafe_imports", "signal_safe_calls",
         "mesh_axis_names", "mesh_axis_home", "multidevice_dirs",
