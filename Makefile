@@ -205,6 +205,61 @@ pipeline-smoke:
 	$(PY) tools/pipeline_smoke.py 2>&1 | tee -a "$$L" && \
 	grep -q "pipeline-smoke OK" "$$L"
 
+# multi-tenant hot-swap smoke (ISSUE 20): a 2-tenant host — lenet5
+# plus a pre-exported StableHLO side artifact — serves a paced JSONL
+# stream while tenant lenet5's weights hot-swap mid-stream (a
+# {"control": "swap"} line on stdin; perturb path: new fingerprint
+# without a second checkpoint). Gates: every data line answered (zero
+# drops — in-flight old-edition requests drain untouched), responses
+# from BOTH weight editions observed (the atomic flip landed
+# mid-stream), the side tenant untouched, and the grep-stable
+# `[tenancy] swaps=1 evictions=E` exit line. Evidence log under logs/.
+swap-smoke:
+	@mkdir -p logs; L="logs/swap-smoke-$$(date +%Y-%m-%d-%H-%M-%S).log"; \
+	$(PY) -c "import numpy as np; \
+	    from deepvision_tpu.export import export_forward, save_exported; \
+	    rng = np.random.default_rng(0); \
+	    w = rng.normal(size=(8, 10)).astype(np.float32); \
+	    save_exported('logs/swap-smoke-side.stablehlo', \
+	        export_forward(lambda v, x: x @ v['w'], {'w': w}, \
+	                       np.zeros((1, 8), np.float32), \
+	                       train_kwarg=False))" && \
+	$(PY) -c "import json, time, numpy as np; \
+	    x32 = np.zeros((32, 32, 1)).tolist(); \
+	    x8 = np.zeros(8).tolist(); \
+	    emit = lambda o: (print(json.dumps(o), flush=True), \
+	                      time.sleep(0.04)); \
+	    [emit({'id': i, 'model': 'lenet5', 'input': x32}) \
+	     for i in range(10)]; \
+	    [emit({'id': 100 + i, 'model': 'side', 'input': x8}) \
+	     for i in range(3)]; \
+	    emit({'control': 'swap', 'model': 'lenet5', 'perturb': 0.01}); \
+	    [emit({'id': 200 + i, 'model': 'lenet5', 'input': x32}) \
+	     for i in range(30)]; \
+	    [emit({'id': 300 + i, 'model': 'side', 'input': x8}) \
+	     for i in range(3)]" \
+	| $(PY) serve.py -m lenet5 \
+	    --artifact side=logs/swap-smoke-side.stablehlo --buckets 1 \
+	    2> "$$L" \
+	| $(PY) -c "import sys, json; \
+	    rows = [json.loads(l) for l in sys.stdin if l.strip()]; \
+	    ok = [r for r in rows if 'result' in r]; \
+	    assert len(ok) == 46, (len(ok), rows[:3]); \
+	    side = [r for r in ok \
+	            if 100 <= r['id'] < 200 or r['id'] >= 300]; \
+	    assert len(side) == 6, side; \
+	    pre = {tuple(r['result']['probs']) for r in ok \
+	           if r['id'] < 100}; \
+	    post = [tuple(r['result']['probs']) for r in \
+	            sorted((r for r in ok if 200 <= r['id'] < 300), \
+	                   key=lambda r: r['id'])]; \
+	    assert len(pre) == 1, 'pre-swap answers must agree'; \
+	    assert post[-1] not in pre, 'swap never landed mid-stream'; \
+	    print('swap-smoke stream OK (46/46 responses, both', \
+	          'editions observed)')" && \
+	grep -qE "\[tenancy\] swaps=1 evictions=[0-9]+" "$$L" && \
+	echo "swap-smoke OK (2 tenants, zero drops, hot-swap mid-stream)"
+
 # router smoke: boot a 2-replica lenet process fleet behind the router
 # (serve.py --fleet), stream 24 JSONL requests through it while the
 # chaos schedule SIGKILLs one replica at routed-request #5, and assert
@@ -404,7 +459,7 @@ threadcheck-smoke:
 # whole-zoo shape gate + full suite (the suite's own full-registry
 # evalcheck test is deselected — `lint` above just ran the identical
 # ~2-min gate via the CLI)
-check: lint lint-comms serve-smoke pipeline-smoke router-smoke stream-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke zero1-smoke
+check: lint lint-comms serve-smoke pipeline-smoke router-smoke stream-smoke swap-smoke obs-smoke obs-fleet-smoke chaos-smoke chaos-dist-smoke chaos-sdc-smoke feed-smoke threadcheck-smoke precision-smoke zero1-smoke
 	$(PY) -m pytest tests/ -x -q \
 		--deselect tests/test_jaxlint.py::test_evalcheck_full_registry
 
@@ -528,4 +583,4 @@ find-python:
 list-models:
 	@echo $(MODELS)
 
-.PHONY: test smoke lint lint-threads lint-ir lint-comms bf16-ready precision-smoke zero1-smoke check serve-smoke pipeline-smoke router-smoke stream-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal
+.PHONY: test smoke lint lint-threads lint-ir lint-comms bf16-ready precision-smoke zero1-smoke check serve-smoke pipeline-smoke router-smoke stream-smoke swap-smoke obs-smoke obs-fleet-smoke feed-smoke chaos-dist-smoke chaos-sdc-smoke threadcheck-smoke bench dryrun tensorboard find-python list-models rehearsal

@@ -2210,6 +2210,134 @@ def streams_bench() -> dict:
     }
 
 
+# ---------------------- tenancy bench (`python bench.py tenancy`) --------
+# Multi-tenant serving economics (ISSUE 20) in one JSON row:
+#   cold-start A/B — a fresh replica's warmup when it must TRACE every
+#   (model, bucket) executable vs when it warms from a populated
+#   --store AOT artifact directory (the PR 6 respawn compile storm vs
+#   its fix), gated on the second warm paying zero compile-cache
+#   misses;
+#   hot-swap drill — closed-loop load on the tenant while its weights
+#   hot-swap mid-stream (perturb path: new fingerprint, no second
+#   checkpoint), gated on zero dropped requests, and reporting p95
+#   during the swap window vs steady-state so the "zero-drop" claim
+#   carries its latency cost.
+TENANCY_LOAD_THREADS = int(os.environ.get("BENCH_TENANCY_THREADS", "3"))
+TENANCY_PHASE_S = float(os.environ.get("BENCH_TENANCY_PHASE_S", "1.5"))
+
+
+def tenancy_bench() -> dict:
+    import contextlib
+    import tempfile
+    import threading
+
+    from deepvision_tpu.core.mesh import create_mesh
+    from deepvision_tpu.serve import InferenceEngine
+    from deepvision_tpu.serve.models import load_served
+
+    rng = np.random.default_rng(0)
+    store = tempfile.mkdtemp(prefix="dvt-aot-bench-")
+    mesh = create_mesh(1, 1)
+    buckets = (1, 4, 16)
+
+    def fresh_engine():
+        # restore chatter to stderr: stdout is the one-JSON-line
+        # contract
+        with contextlib.redirect_stdout(sys.stderr):
+            served = load_served("lenet5", None, num_classes=10)
+        return InferenceEngine([served], mesh=mesh, buckets=buckets,
+                               max_queue=1024, store=store)
+
+    # 1) cold-start A/B: trace everything (and populate the store)...
+    eng = fresh_engine()
+    warm_trace_s = eng.warmup_s
+    store_puts = eng.stats()["artifact_store"]["puts"]
+    eng.close()
+    # ...vs warm the SAME ladder from disk on the respawn
+    eng = fresh_engine()
+    warm_store_s = eng.warmup_s
+    stats = eng.stats()
+    warmed_from_store = stats["warmed_from_store"]
+    second_warm_misses = stats["cache"]["misses"]
+
+    # 2) hot-swap drill under closed-loop load
+    xs = rng.normal(size=(64, 32, 32, 1)).astype(np.float32)
+    lat, errors = [], []  # (t_done, seconds) samples
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def pound():
+        i = 0
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                eng.submit(xs[i % len(xs)]).result(timeout=60)
+                t1 = time.perf_counter()
+                with lock:
+                    lat.append((t1, t1 - t0))
+            except Exception as e:  # any drop under swap is the bug
+                with lock:
+                    errors.append(repr(e))
+            i += 1
+
+    threads = [threading.Thread(target=pound)
+               for _ in range(TENANCY_LOAD_THREADS)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(TENANCY_PHASE_S)  # steady state on old weights
+        swap_t0 = time.perf_counter()
+        swap = eng.hot_swap("lenet5", perturb=0.01)
+        swap_t1 = time.perf_counter()
+        time.sleep(TENANCY_PHASE_S)  # steady state on new weights
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        tenancy = eng.tenancy.stats()
+        eng.close()
+
+    def p95_ms(samples):
+        if not samples:
+            return None
+        return round(float(np.percentile(
+            [s * 1e3 for s in samples], 95)), 1)
+
+    steady = [d for t, d in lat if t < swap_t0 or t > swap_t1 + 0.2]
+    during = [d for t, d in lat if swap_t0 <= t <= swap_t1 + 0.2]
+    speedup = round(warm_trace_s / warm_store_s, 2) \
+        if warm_store_s > 0 else None
+    return {
+        "metric": "tenancy_cold_start_speedup",
+        "value": speedup,
+        "unit": "x",
+        "warm_from_trace_s": warm_trace_s,
+        "warm_from_store_s": warm_store_s,
+        "store_puts": store_puts,
+        "warmed_from_store": warmed_from_store,
+        "second_warm_cache_misses": second_warm_misses,
+        "hot_swap": {
+            "swap_s": round(swap_t1 - swap_t0, 3),
+            "dropped_requests": len(errors),
+            "errors": errors[:5],
+            "requests_completed": len(lat),
+            "p95_steady_ms": p95_ms(steady),
+            "p95_during_swap_ms": p95_ms(during),
+            "swapped_fingerprint": swap["fingerprint"],
+            "dropped_executables": swap["dropped_executables"],
+            "swaps": tenancy["swaps"],
+        },
+        "gates": {
+            "no_retrace_on_store_warm": second_warm_misses == 0,
+            "zero_dropped_during_swap": not errors,
+            "exactly_one_swap": tenancy["swaps"] == 1,
+        },
+        "pass": (second_warm_misses == 0 and not errors
+                 and tenancy["swaps"] == 1),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 if __name__ == "__main__":
 
     # BENCH_TRACE=path: span-trace the bench itself (the feed loops
@@ -2255,6 +2383,8 @@ if __name__ == "__main__":
             print(json.dumps(pipeline_bench()))
         elif "streams" in sys.argv[1:]:
             print(json.dumps(streams_bench()))
+        elif "tenancy" in sys.argv[1:]:
+            print(json.dumps(tenancy_bench()))
         elif "serve" in sys.argv[1:]:
             if "--sweep" in sys.argv[1:]:
                 print(json.dumps(serve_sweep_bench()))

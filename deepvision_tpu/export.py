@@ -33,6 +33,34 @@ def export_forward(apply_fn, variables, sample_input, *, train_kwarg=True):
     return exported.serialize()
 
 
+def export_callable(fn, in_avals) -> bytes:
+    """Lower an arbitrary jit-able callable at explicit input avals and
+    return the serialized StableHLO bytes. The general form of
+    :func:`export_forward` — the serve artifact store uses it to
+    persist a ``ServedModel``'s whole request program (forward +
+    in-graph post-processing, weights baked in as constants) keyed by
+    compile-cache bucket."""
+    exported = jax_export.export(jax.jit(fn))(*in_avals)
+    return exported.serialize()
+
+
+def deserialize_exported(data: bytes):
+    """StableHLO bytes -> callable — the in-memory dual of
+    :func:`load_exported` for callers that manage their own files and
+    integrity manifests (``serve.artifact_store``). The callable
+    carries the same ``.in_avals`` / ``.out_avals`` / ``.exported``
+    metadata contract."""
+    exported = jax_export.deserialize(data)
+
+    def call(*args):
+        return exported.call(*args)
+
+    call.in_avals = exported.in_avals
+    call.out_avals = exported.out_avals
+    call.exported = exported
+    return call
+
+
 def save_exported(path: str | Path, data: bytes) -> Path:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -53,12 +81,4 @@ def load_exported(path: str | Path):
     shapes/dtypes to type-check a DAG edge BEFORE any compile, and a
     caller feeding the wrong shape should find out from the spec, not
     a runtime shape error."""
-    exported = jax_export.deserialize(Path(path).read_bytes())
-
-    def call(*args):
-        return exported.call(*args)
-
-    call.in_avals = exported.in_avals
-    call.out_avals = exported.out_avals
-    call.exported = exported
-    return call
+    return deserialize_exported(Path(path).read_bytes())

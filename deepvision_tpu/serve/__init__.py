@@ -11,8 +11,20 @@ The serving runtime layer (ROADMAP north star: "serves heavy traffic"):
                       restarts with backoff, and degrades ``health()``
                       (``/healthz`` 503) while recovering.
 - ``compile_cache`` : LRU of AOT-compiled executables keyed by
-                      (model, bucket, dtype), eagerly warmed so no
-                      request pays a trace.
+                      (model, bucket, dtype, weights fingerprint),
+                      eagerly warmed so no request pays a trace.
+- ``tenancy``       : multi-tenant weight residency — N models share
+                      one replica's HBM behind an LRU budget (cold
+                      tenants evicted to host, re-materialized on
+                      demand), zero-drop weight hot-swap (new ladder
+                      pre-compiled off the dispatch path, atomic
+                      edition flip between batches), per-tenant
+                      admission quotas + SLO classes.
+- ``artifact_store``: persistent on-disk AOT store — StableHLO request
+                      programs keyed like compile-cache buckets with
+                      SHA-256 manifests; replicas warm from disk
+                      instead of re-tracing on respawn, corrupt blobs
+                      quarantine with fallback to trace.
 - ``models``        : ServedModel — one restore + per-task postprocess
                       path (classify/detect/pose/gan) shared by
                       ``predict.py`` and the server; also wraps
@@ -45,6 +57,7 @@ latency-throughput curve + SIGKILL chaos drill.
 """
 
 from deepvision_tpu.serve.admission import AdmissionController, ShedError
+from deepvision_tpu.serve.artifact_store import ArtifactStore
 from deepvision_tpu.serve.compile_cache import CompileCache
 from deepvision_tpu.serve.engine import InferenceEngine
 from deepvision_tpu.serve.models import (
@@ -78,11 +91,15 @@ from deepvision_tpu.serve.telemetry import (
     RouterTelemetry,
     ServeTelemetry,
 )
+from deepvision_tpu.serve.tenancy import TenancyManager, WeightsEdition
 
 __all__ = [
     "AdmissionController",
     "ShedError",
+    "ArtifactStore",
     "CompileCache",
+    "TenancyManager",
+    "WeightsEdition",
     "InferenceEngine",
     "ServedModel",
     "ModelStage",

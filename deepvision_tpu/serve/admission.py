@@ -18,7 +18,13 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["AdmissionController", "ShedError"]
+__all__ = ["AdmissionController", "ShedError", "SLO_CLASSES"]
+
+# multi-tenant SLO classes: the fraction of ``max_queue`` a tenant of
+# that class may occupy while the host is under CONTENTION (someone
+# else is queued too). A lone tenant always gets the whole queue —
+# classes ration the shared budget, they don't strand idle capacity.
+SLO_CLASSES = {"gold": 1.0, "standard": 0.8, "batch": 0.5}
 
 
 class ShedError(RuntimeError):
@@ -44,6 +50,21 @@ class AdmissionController:
       could only produce a late answer, and the shed's retry hint is
       honest about when capacity returns.
 
+    Multi-tenant isolation (``serve/tenancy.py`` story; tenant ==
+    model name):
+
+    - ``tenant_quota``: hard per-tenant queued-request caps — a noisy
+      tenant hits ITS quota and sheds alone while everyone else keeps
+      their slots.
+    - ``slo_class``: tenant -> ``gold``/``standard``/``batch``
+      (:data:`SLO_CLASSES`). Under contention (another tenant is
+      queued), a tenant may only occupy its class's fraction of
+      ``max_queue`` — batch traffic yields queue budget to gold
+      traffic exactly when it matters and keeps the whole host when
+      alone.
+    - ``sheds_by_tenant`` (in :meth:`stats`) attributes every shed to
+      the tenant that was rejected — the isolation-drill evidence.
+
     ``observe_batch`` maintains an EWMA of per-row service time; the
     shed hint is ``depth × row_s`` — how long the current backlog needs
     to drain at the observed rate.
@@ -52,40 +73,74 @@ class AdmissionController:
     def __init__(self, max_queue: int = 256,
                  per_model_limit: int | None = None,
                  ewma_alpha: float = 0.2,
-                 slo_budget_s: dict[str, float] | None = None):
+                 slo_budget_s: dict[str, float] | None = None,
+                 tenant_quota: dict[str, int] | None = None,
+                 slo_class: dict[str, str] | None = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        for tenant, cls in (slo_class or {}).items():
+            if cls not in SLO_CLASSES:
+                raise ValueError(
+                    f"unknown SLO class {cls!r} for tenant {tenant!r}; "
+                    f"choose from {sorted(SLO_CLASSES)}")
+        for tenant, quota in (tenant_quota or {}).items():
+            if int(quota) < 1:
+                raise ValueError(
+                    f"tenant {tenant!r} quota must be >= 1, got {quota}")
         self.max_queue = max_queue
         self.per_model_limit = per_model_limit
         self.slo_budget_s = dict(slo_budget_s or {})
+        self.tenant_quota = {t: int(q)
+                             for t, q in (tenant_quota or {}).items()}
+        self.slo_class = dict(slo_class or {})
         self._alpha = ewma_alpha
         self._lock = threading.Lock()
         self._counts: dict[str, int] = {}
+        self._sheds: dict[str, int] = {}
         self._total = 0
         self._row_s = 0.005  # EWMA per-row service time (seed guess)
 
     # -- admission -------------------------------------------------------
+    def _shed_locked(self, model: str, message: str) -> ShedError:
+        self._sheds[model] = self._sheds.get(model, 0) + 1
+        return ShedError(message, self._retry_after_locked())
+
     def admit(self, model: str) -> None:
-        """Reserve a queue slot for one request, or raise ShedError."""
+        """Reserve a queue slot for one request, or raise ShedError.
+        Every rejection is attributed to ``model`` in
+        ``sheds_by_tenant`` — per-tenant isolation must be provable
+        from stats, not inferred."""
         with self._lock:
             if self._total >= self.max_queue:
-                raise ShedError(
-                    f"queue full ({self._total}/{self.max_queue} pending)",
-                    self._retry_after_locked())
+                raise self._shed_locked(model, (
+                    f"queue full ({self._total}/{self.max_queue} "
+                    "pending)"))
             if self.per_model_limit is not None \
                     and self._counts.get(model, 0) >= self.per_model_limit:
-                raise ShedError(
+                raise self._shed_locked(model, (
                     f"model {model!r} at its concurrency limit "
-                    f"({self.per_model_limit})",
-                    self._retry_after_locked())
+                    f"({self.per_model_limit})"))
+            quota = self.tenant_quota.get(model)
+            if quota is not None and self._counts.get(model, 0) >= quota:
+                raise self._shed_locked(model, (
+                    f"tenant {model!r} at its admission quota "
+                    f"({quota})"))
+            cls = self.slo_class.get(model)
+            if cls is not None:
+                mine = self._counts.get(model, 0)
+                contended = self._total > mine  # someone else is queued
+                share = int(SLO_CLASSES[cls] * self.max_queue)
+                if contended and mine >= max(1, share):
+                    raise self._shed_locked(model, (
+                        f"tenant {model!r} ({cls}) at its contended "
+                        f"share ({share}/{self.max_queue})"))
             budget = self.slo_budget_s.get(model)
             if budget is not None:
                 est_wait = self._total * self._row_s
                 if est_wait > budget:
-                    raise ShedError(
+                    raise self._shed_locked(model, (
                         f"estimated queue wait {est_wait:.3f}s exceeds "
-                        f"model {model!r} p95 budget {budget}s",
-                        self._retry_after_locked())
+                        f"model {model!r} p95 budget {budget}s"))
             self._counts[model] = self._counts.get(model, 0) + 1
             self._total += 1
 
@@ -122,6 +177,11 @@ class AdmissionController:
                 "per_model_limit": self.per_model_limit,
                 "per_model_depth": dict(self._counts),
                 "ewma_row_ms": round(self._row_s * 1e3, 3),
+                "sheds_by_tenant": dict(self._sheds),
                 **({"slo_budget_s": dict(self.slo_budget_s)}
                    if self.slo_budget_s else {}),
+                **({"tenant_quota": dict(self.tenant_quota)}
+                   if self.tenant_quota else {}),
+                **({"slo_class": dict(self.slo_class)}
+                   if self.slo_class else {}),
             }

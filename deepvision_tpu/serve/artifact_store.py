@@ -1,0 +1,250 @@
+"""Persistent on-disk AOT artifact store for the serving tier.
+
+Serialized StableHLO request programs (``export.export_callable``
+bytes: forward + in-graph post-processing, weights baked in as
+constants) keyed exactly like ``compile_cache`` buckets — (model,
+bucket, dtype, mesh, weights fingerprint) — so a fresh replica warms
+its executables FROM DISK instead of re-tracing every (model, bucket)
+pair: the multi-second first-burst compile storm PR 6 measured on
+respawn becomes a deserialize.
+
+Integrity follows the PR 4 checkpoint-manifest pattern
+(``train/manifest.py``): every blob is recorded in ``manifest.json``
+with size + SHA-256, writes stage through a tmp file unique to the
+writer (pid + monotonic counter) and commit with one atomic
+``os.replace``, and a blob that fails verification on read is MOVED to
+``quarantine/`` (evidence, not deletion) while the caller falls back
+to trace-compile. Several replicas of one fleet can therefore share a
+``--store DIR`` safely: concurrent writers each stage complete bytes,
+the last manifest replace wins with a valid file, and a writer killed
+mid-stage leaves only its own tmp file, which readers ignore.
+
+Concurrency shape (the JX119 contract): byte I/O never happens under
+``_lock``. The in-process authority is an in-memory entries dict the
+lock protects; blob bytes and manifest snapshots are staged to
+writer-unique tmp files OUTSIDE the lock, and only the metadata-cheap
+atomic ``os.replace`` commit (guarded by a snapshot sequence number so
+an older snapshot can never overwrite a newer one) happens inside it.
+
+The weights fingerprint in the key makes hot-swap coherent end to end:
+a swapped tenant's new weights hash to a new fingerprint, so stale
+artifacts exported under the old weights can never pair with them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import shutil
+import threading
+from pathlib import Path
+
+__all__ = ["ArtifactStore", "mesh_desc"]
+
+STORE_VERSION = 1
+
+_tmp_seq = itertools.count()
+
+
+def mesh_desc(mesh) -> str:
+    """Canonical mesh descriptor for store keys: platform + axis
+    geometry. An artifact lowered for a 4-device data axis is not
+    loadable into a 2-device mesh — the descriptor keeps such blobs
+    from ever being offered."""
+    dev = mesh.devices.flat[0]
+    axes = ",".join(f"{n}={s}" for n, s in
+                    zip(mesh.axis_names, mesh.devices.shape))
+    return f"{dev.platform}:{axes}"
+
+
+def _entry_key(model: str, bucket: int, dtype: str, mesh: str,
+               fingerprint: str) -> str:
+    return f"{model}|{bucket}|{dtype}|{mesh}|{fingerprint}"
+
+
+def _load_manifest_entries(path: Path) -> dict:
+    try:
+        data = json.loads(path.read_text())
+        entries = data.get("entries")
+        if not isinstance(entries, dict):
+            raise ValueError("manifest has no entries mapping")
+        return entries
+    except (OSError, ValueError):
+        return {}
+
+
+class ArtifactStore:
+    """Content-verified blob store under one root directory.
+
+    Layout::
+
+        root/manifest.json          # key -> {file, size, sha256, ...}
+        root/blobs/<model>/<hash>.stablehlo
+        root/quarantine/            # blobs that failed verification
+
+    ``get`` returns the verified bytes or ``None`` (miss, or corrupt
+    entry quarantined) — callers always have the trace-compile
+    fallback, so the store can never make serving *less* available
+    than having no store at all.
+    """
+
+    def __init__(self, root: str | Path, *, log=print):
+        self.root = Path(root)
+        self._log = log
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.quarantined = 0
+        (self.root / "blobs").mkdir(parents=True, exist_ok=True)
+        # in-process authority for entries; disk is re-consulted on a
+        # miss so another replica's puts stay visible (shared --store)
+        self._entries = _load_manifest_entries(self._manifest_path)
+        self._snap_seq = 0       # snapshot sequence, taken under _lock
+        self._committed_seq = 0  # newest snapshot committed to disk
+
+    # -- manifest ---------------------------------------------------------
+    @property
+    def _manifest_path(self) -> Path:
+        return self.root / "manifest.json"
+
+    def _snapshot_locked(self) -> tuple[int, dict]:
+        """Consistent manifest snapshot + its sequence number. Caller
+        must hold ``_lock``; the snapshot is written to disk AFTER
+        releasing it."""
+        self._snap_seq += 1
+        return self._snap_seq, {
+            "version": STORE_VERSION,
+            "entries": {k: dict(v) for k, v in self._entries.items()},
+        }
+
+    def _commit_manifest(self, seq: int, manifest: dict) -> None:
+        """Stage the snapshot outside the lock, commit the atomic
+        replace under it — guarded so a slower writer holding an OLDER
+        snapshot can never clobber a newer committed one."""
+        tmp = self._manifest_path.with_suffix(
+            f".json.tmp.{os.getpid()}.{next(_tmp_seq)}")
+        tmp.write_text(json.dumps(manifest, indent=0, sort_keys=True))
+        with self._lock:
+            if seq > self._committed_seq:
+                os.replace(tmp, self._manifest_path)
+                self._committed_seq = seq
+                return
+        tmp.unlink(missing_ok=True)  # superseded snapshot
+
+    def entries(self) -> dict:
+        """The current manifest entries (key -> metadata dict)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    # -- put / get --------------------------------------------------------
+    def put(self, data: bytes, *, model: str, bucket: int, dtype: str,
+            mesh: str, fingerprint: str) -> Path:
+        """Persist one artifact: stage the blob through a writer-unique
+        tmp file, commit with ``os.replace``, then commit the manifest
+        entry the same way. Idempotent for identical content."""
+        key = _entry_key(model, bucket, dtype, mesh, fingerprint)
+        digest = hashlib.sha256(data).hexdigest()
+        # human-greppable model dir; the rest of the key hashed into
+        # the filename (mesh/dtype strings carry separators)
+        blob_rel = Path("blobs") / model / (
+            hashlib.sha256(key.encode()).hexdigest()[:24] + ".stablehlo")
+        target = self.root / blob_rel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        tmp = target.with_suffix(
+            f".stablehlo.tmp.{os.getpid()}.{next(_tmp_seq)}")
+        tmp.write_bytes(data)
+        os.replace(tmp, target)
+        with self._lock:
+            self._entries[key] = {
+                "file": str(blob_rel), "size": len(data),
+                "sha256": digest, "model": model, "bucket": int(bucket),
+                "dtype": dtype, "mesh": mesh, "fingerprint": fingerprint,
+            }
+            self.puts += 1
+            seq, manifest = self._snapshot_locked()
+        self._commit_manifest(seq, manifest)
+        return target
+
+    def get(self, *, model: str, bucket: int, dtype: str, mesh: str,
+            fingerprint: str) -> bytes | None:
+        """Verified bytes for one key, or ``None``. A manifest entry
+        whose blob is missing, truncated, or hash-mismatched is
+        quarantined on the way past and reported as a miss — the
+        caller falls back to trace-compile."""
+        key = _entry_key(model, bucket, dtype, mesh, fingerprint)
+        with self._lock:
+            want = self._entries.get(key)
+        if want is None:
+            # another replica of the fleet may have exported it since
+            # our last look: re-consult the shared on-disk manifest
+            disk = _load_manifest_entries(self._manifest_path).get(key)
+            if disk is None:
+                with self._lock:
+                    self.misses += 1
+                return None
+            with self._lock:
+                want = self._entries.setdefault(key, dict(disk))
+        path = self.root / want.get("file", "")
+        try:
+            data = path.read_bytes()
+            if len(data) != want["size"]:
+                raise ValueError(
+                    f"size mismatch: {len(data)} != {want['size']}")
+            if hashlib.sha256(data).hexdigest() != want["sha256"]:
+                raise ValueError("checksum mismatch")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            self._quarantine(key, want, reason=str(e))
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return data
+
+    def reject(self, *, model: str, bucket: int, dtype: str, mesh: str,
+               fingerprint: str, reason: str) -> None:
+        """Quarantine a verified-but-unusable entry: the bytes passed
+        integrity checks but the program cannot execute on this
+        backend (e.g. a custom call without serialization-compat
+        guarantees). Rejecting it keeps every future warm from paying
+        the same failed deserialize+compile before falling back."""
+        key = _entry_key(model, bucket, dtype, mesh, fingerprint)
+        with self._lock:
+            entry = self._entries.get(key)
+        if entry is not None:
+            self._quarantine(key, entry, reason=reason)
+
+    def _quarantine(self, key: str, entry: dict, *, reason: str) -> None:
+        """Move a failing blob to ``quarantine/`` (evidence, not
+        deletion) and drop its manifest entry, mirroring
+        ``train/manifest.newest_verified_epoch``."""
+        self._log(f"[artifact-store] {key}: {reason}; quarantining",
+                  flush=True)
+        qroot = self.root / "quarantine"
+        qroot.mkdir(exist_ok=True)
+        src = self.root / entry.get("file", "")
+        if src.is_file():
+            target = qroot / src.name
+            n = 0
+            while target.exists():
+                n += 1
+                target = qroot / f"{src.name}.{n}"
+            shutil.move(str(src), str(target))
+        with self._lock:
+            self._entries.pop(key, None)
+            self.quarantined += 1
+            seq, manifest = self._snapshot_locked()
+        self._commit_manifest(seq, manifest)
+
+    # -- observability ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "root": str(self.root),
+                "entries": len(self._entries),
+                "hits": self.hits, "misses": self.misses,
+                "puts": self.puts, "quarantined": self.quarantined,
+            }

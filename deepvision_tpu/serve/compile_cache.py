@@ -5,9 +5,14 @@ are shape-specialized — a naive per-request ``jit`` retraces on every
 new batch size and the chip spends its time in the compiler instead of
 the MXU (jaxlint JX110 flags exactly that pattern). The engine instead
 pads every micro-batch up to a fixed bucket ladder and runs a
-pre-compiled executable per ``(model, bucket, dtype)`` key, all of them
-compiled eagerly at startup (:meth:`CompileCache.warmup` via
-``engine.InferenceEngine``) so no request ever pays a trace.
+pre-compiled executable per ``(model, bucket, dtype, weights
+fingerprint)`` key, all of them compiled eagerly at startup
+(:meth:`CompileCache.warmup` via ``engine.InferenceEngine``) so no
+request ever pays a trace. The weights fingerprint exists for hot-swap
+coherence: swapping a tenant's weights changes its fingerprint, so a
+stale executable compiled against the old weights can never be *hit*
+for the new ones — the swap path pre-compiles and :meth:`install`\\ s
+the new ladder, then :meth:`drop_where` retires the old keys.
 
 The cache is an LRU so a long-lived multi-model host with a rotating
 model set stays bounded; with the default ladder (4 buckets × a few
@@ -26,7 +31,8 @@ __all__ = ["CompileCache"]
 
 
 class CompileCache:
-    """LRU of compiled executables keyed by ``(model, bucket, dtype)``.
+    """LRU of compiled executables keyed by ``(model, bucket, dtype,
+    weights fingerprint)``.
 
     ``build`` callables passed to :meth:`get_or_build` return the ready
     runner (typically an AOT ``jit(...).lower(...).compile()`` wrapper);
@@ -78,6 +84,31 @@ class CompileCache:
                 self._entries.popitem(last=False)
                 self.evictions += 1
             return runner
+
+    def install(self, key: Hashable, runner: Callable) -> None:
+        """Insert a ready executable, bypassing the miss path. This is
+        the deliberate post-warmup mutation channel — hot-swap
+        pre-compiles a tenant's new-fingerprint ladder off the dispatch
+        path and installs it here, and the artifact store installs
+        deserialized StableHLO runners at warm — so it works on a
+        FROZEN cache and counts as neither hit nor miss (the
+        miss-freeze tripwire keeps meaning "a request paid a trace")."""
+        with self._lock:
+            self._entries[key] = runner
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def drop_where(self, pred: Callable[[Hashable], bool]) -> int:
+        """Remove entries whose key satisfies ``pred`` (hot-swap drops
+        the old fingerprint's executables — unreachable once the key
+        changed). Returns the count; not counted as LRU evictions."""
+        with self._lock:
+            stale = [k for k in self._entries if pred(k)]
+            for k in stale:
+                del self._entries[k]
+            return len(stale)
 
     def contains(self, key: Hashable) -> bool:
         with self._lock:

@@ -5,7 +5,8 @@ the training side already enforces (jaxlint JX105/JX110): a background
 dispatcher thread drains a bounded request queue into per-model
 micro-batches, pads each batch with zero rows up to a fixed bucket
 ladder (default 1/4/16/64), and runs a pre-compiled, input-donated,
-mesh-sharded forward per ``(model, bucket, dtype)`` from the
+mesh-sharded forward per ``(model, bucket, dtype, weights
+fingerprint)`` from the
 :class:`~deepvision_tpu.serve.compile_cache.CompileCache` — eagerly
 warmed at startup so no request ever pays a trace. This is the MLPerf
 serving recipe (PAPERS.md "Scale MLPerf-0.6 models on Google TPU-v3
@@ -110,6 +111,14 @@ class InferenceEngine:
     ``freeze_cache``: freeze the compile cache after warmup — any
     request-time miss raises instead of tracing, proving no request
     (pipeline or plain) can ever pay a hidden compile.
+
+    Multi-tenancy (``serve/tenancy.py``): ``store`` (an
+    ``ArtifactStore`` or a directory path) warms executables from
+    disk and exports trace-compiled ones back; ``residency_bytes``
+    caps resident weight bytes with LRU eviction to host;
+    ``tenant_quota`` / ``slo_class`` thread per-tenant admission
+    isolation into the :class:`AdmissionController`. :meth:`hot_swap`
+    replaces one tenant's weights under live load with zero drops.
     """
 
     def __init__(
@@ -129,6 +138,10 @@ class InferenceEngine:
         restart_backoff_max_s: float = 5.0,
         pipelines: Iterable = (),
         freeze_cache: bool = False,
+        store=None,
+        residency_bytes: int | None = None,
+        tenant_quota: dict[str, int] | None = None,
+        slo_class: dict[str, str] | None = None,
     ):
         if isinstance(models, dict):
             self._models = dict(models)
@@ -159,7 +172,8 @@ class InferenceEngine:
         self.telemetry = telemetry if telemetry is not None \
             else ServeTelemetry()
         self._admission = AdmissionController(
-            max_queue=max_queue, per_model_limit=per_model_limit)
+            max_queue=max_queue, per_model_limit=per_model_limit,
+            tenant_quota=tenant_quota, slo_class=slo_class)
         self._window = batch_window_s
         self._poll_s = 0.05
         self._q: queue.Queue = queue.Queue()
@@ -184,7 +198,20 @@ class InferenceEngine:
         self._restart_backoff_max_s = restart_backoff_max_s
         self._backoff_reset_s = 5.0  # healthy-for-this-long resets backoff
         self.warmup_s = 0.0
-        self._replicate_variables()
+        if store is not None and not hasattr(store, "get"):
+            from deepvision_tpu.serve.artifact_store import ArtifactStore
+
+            store = ArtifactStore(store, log=self._log)
+        self._store = store
+        # (model, bucket, dtype, fp) keys whose executables came off
+        # disk instead of a trace — the respawn-without-compile-storm
+        # evidence ``stats()`` reports and bench pins
+        self._from_store: set = set()
+        from deepvision_tpu.serve.tenancy import TenancyManager
+
+        self._tenancy = TenancyManager(
+            self._mesh, budget_bytes=residency_bytes, log=self._log)
+        self._adopt_tenants()
         if warmup:
             self.warm()
             if freeze_cache:
@@ -209,27 +236,46 @@ class InferenceEngine:
                         f"divisible by the mesh data axis ({n_data}); "
                         "batches are sharded over it")
 
-    def _replicate_variables(self) -> None:
-        """Place every model's variables replicated on the mesh once, so
-        per-batch calls never re-place (or worse, re-transfer) params."""
-        import jax
+    @staticmethod
+    def _log(*args, **kw) -> None:
+        # tenancy/store chatter goes to stderr: stdout is the JSONL
+        # protocol stream when serve.py hosts this engine
+        print(*args, file=sys.stderr, **kw)
 
-        from deepvision_tpu.core.mesh import replicated_sharding
-
-        sharding = replicated_sharding(self._mesh)
-        targets = []
+    def _adopt_tenants(self) -> None:
+        """Register every weight-carrying model (pipeline/stateful
+        STAGE models included — shared objects with the plain serving
+        path) with the tenancy manager: one fingerprint + one
+        replicated device placement + a weights edition each, so
+        per-batch calls never re-place (or worse, re-transfer) params
+        and eviction/hot-swap have their seam."""
         for m in self._models.values():
             if getattr(m, "is_pipeline", False) \
                     or getattr(m, "is_stateful", False):
                 # a pipeline's own variables are None; its STAGE models
-                # carry the weights (shared objects with the plain
-                # serving path when a model is served both ways)
-                targets.extend(m.stage_models().values())
+                # carry the weights
+                for sm in m.stage_models().values():
+                    self._tenancy.adopt(sm)
             else:
-                targets.append(m)
-        for m in targets:
-            if m.variables is not None:
-                m.variables = jax.device_put(m.variables, sharding)
+                self._tenancy.adopt(m)
+
+    def _tenant_names(self, served) -> list[str]:
+        if getattr(served, "is_pipeline", False) \
+                or getattr(served, "is_stateful", False):
+            return list(served.stage_models())
+        return [served.name]
+
+    def _model_key(self, m, bucket: int) -> tuple:
+        """Compile-cache key: ``(model, bucket, dtype, weights
+        fingerprint)``. The fingerprint pins an executable to the
+        weights generation it was compiled against — after a hot-swap
+        the key changes, so a stale executable can never silently pair
+        with new weights. Pipelines/stateful wrappers key their front
+        door ``"static"``: their weights live in the per-stage cache
+        entries, which carry the stage fingerprints."""
+        fp = getattr(m, "weights_fingerprint", None)
+        return (m.name, bucket, m.dtype_str,
+                fp() if fp is not None else "static")
 
     def ladder(self, model: ServedModel) -> tuple[int, ...]:
         return model.buckets if model.buckets else self.buckets
@@ -247,7 +293,15 @@ class InferenceEngine:
         would leave the device-array-fed request path still cold.
         Without this, the engine's "no request pays a compile" contract
         silently broke for artifacts (measured as a multi-second stall
-        of the first request burst on every fresh replica)."""
+        of the first request burst on every fresh replica).
+
+        With an artifact store attached, every storeable (model,
+        bucket) first tries the disk: a verified StableHLO blob under
+        this mesh + weights fingerprint deserializes into the cache
+        (``install``, no miss counted) instead of paying the trace —
+        the respawn path PR 6 measured stops re-compiling. Misses
+        trace-compile as before and are exported back into the store,
+        so the first replica of a fleet populates it for the rest."""
         import jax
 
         from deepvision_tpu.core.mesh import data_sharding
@@ -255,24 +309,215 @@ class InferenceEngine:
         t0 = time.perf_counter()
         for m in self._models.values():
             for bucket in self.ladder(m):
-                runner = self._cache.get_or_build(
-                    (m.name, bucket, m.dtype_str),
-                    lambda m=m, bucket=bucket: m.compile_for(
-                        bucket, self._mesh),
-                )
-                if m.precompiled is not None \
+                key = self._model_key(m, bucket)
+                runner = None
+                if self._store is not None and self._storeable(m):
+                    runner = self._load_store_runner(m, bucket)
+                    if runner is not None:
+                        self._cache.install(key, runner)
+                        self._from_store.add(key)
+                from_store = runner is not None
+                if runner is None:
+                    runner = self._cache.get_or_build(
+                        key,
+                        lambda m=m, bucket=bucket: m.compile_for(
+                            bucket, self._mesh),
+                    )
+                    if self._store is not None and self._storeable(m):
+                        self._save_store_entry(m, bucket)
+                if from_store or m.precompiled is not None \
                         or getattr(m, "is_pipeline", False) \
                         or getattr(m, "is_stateful", False):
                     # pipelines zero-execute too: their runners thread
                     # eager device ops (chunk slice/pad/concat, dict
                     # re-packing) between stage executables, and any
-                    # StableHLO stage backend-compiles on first call —
-                    # one warm pass covers the whole DAG
+                    # StableHLO artifact — pre-exported or store-loaded
+                    # — backend-compiles on first call AND specializes
+                    # on input placement, so the zero batch feeds
+                    # through the exact request path
                     x = np.zeros((bucket, *m.input_shape), m.input_dtype)
                     xd = jax.device_put(
                         x, data_sharding(self._mesh, x.ndim))
-                    jax.device_get(runner(xd))
+                    try:
+                        jax.device_get(runner(xd))
+                    except Exception as e:
+                        if not from_store:
+                            raise
+                        # the blob deserialized but cannot EXECUTE on
+                        # this backend (e.g. a custom call without
+                        # serialization-compat guarantees): reject it
+                        # so future respawns skip it, and trace-compile
+                        # — the store must never make warmup fail, only
+                        # faster. No re-export: the same program just
+                        # proved un-runnable from serialized form here.
+                        self._log(
+                            f"[artifact-store] {m.name}@{bucket}: "
+                            f"stored program failed to execute ({e}); "
+                            "rejecting + re-tracing")
+                        self._reject_store_entry(m, bucket,
+                                                 reason=str(e))
+                        self._cache.drop_where(
+                            lambda k, key=key: k == key)
+                        self._from_store.discard(key)
+                        self._cache.get_or_build(
+                            key,
+                            lambda m=m, bucket=bucket: m.compile_for(
+                                bucket, self._mesh),
+                        )
         self.warmup_s = round(time.perf_counter() - t0, 3)
+
+    def _storeable(self, m) -> bool:
+        """Models whose request program the artifact store can carry:
+        plain weight-backed forwards. Pipelines re-assemble from their
+        (storeable) stages' trace path, pre-exported artifacts already
+        ARE serialized programs, and stateful wrappers hold live
+        device state no AOT blob can bake in."""
+        return (not getattr(m, "is_pipeline", False)
+                and not getattr(m, "is_stateful", False)
+                and getattr(m, "precompiled", None) is None
+                and getattr(m, "variables", None) is not None)
+
+    def _load_store_runner(self, m, bucket: int):
+        """Verified store bytes -> runner, or None (miss / corrupt —
+        the store quarantined it — / undeserializable): the caller
+        falls back to trace-compile, so the store never makes warmup
+        *fail*, only faster."""
+        from deepvision_tpu.export import deserialize_exported
+        from deepvision_tpu.serve.artifact_store import mesh_desc
+
+        data = self._store.get(
+            model=m.name, bucket=bucket, dtype=m.dtype_str,
+            mesh=mesh_desc(self._mesh),
+            fingerprint=m.weights_fingerprint())
+        if data is None:
+            return None
+        try:
+            return deserialize_exported(data)
+        except Exception as e:
+            self._log(f"[artifact-store] {m.name}@{bucket}: "
+                      f"deserialize failed ({e}); re-tracing")
+            return None
+
+    def _save_store_entry(self, m, bucket: int) -> None:
+        """Best-effort export into the store — a full disk must never
+        take serving down with it."""
+        from deepvision_tpu.serve.artifact_store import mesh_desc
+
+        try:
+            self._store.put(
+                m.export_bytes(bucket), model=m.name, bucket=bucket,
+                dtype=m.dtype_str, mesh=mesh_desc(self._mesh),
+                fingerprint=m.weights_fingerprint())
+        except Exception as e:
+            self._log(f"[artifact-store] export {m.name}@{bucket} "
+                      f"failed: {e}")
+
+    def _reject_store_entry(self, m, bucket: int, *,
+                            reason: str) -> None:
+        """Quarantine a store entry that deserialized but could not
+        execute here — best-effort, like every store write."""
+        from deepvision_tpu.serve.artifact_store import mesh_desc
+
+        try:
+            self._store.reject(
+                model=m.name, bucket=bucket, dtype=m.dtype_str,
+                mesh=mesh_desc(self._mesh),
+                fingerprint=m.weights_fingerprint(), reason=reason)
+        except Exception as e:
+            self._log(f"[artifact-store] reject {m.name}@{bucket} "
+                      f"failed: {e}")
+
+    # -- tenancy ---------------------------------------------------------
+    def hot_swap(self, name: str, variables=None, *,
+                 workdir: str | None = None,
+                 perturb: float | None = None) -> dict:
+        """Zero-drop weight hot-swap for one tenant. Runs on the
+        CALLER's thread: the new weights are staged and the whole
+        bucket ladder pre-compiled off the dispatch path, then the
+        tenant's weights edition flips atomically between batches —
+        requests already dispatched against the pre-swap executables
+        drain on the pre-swap weights (their runners keep their
+        compile-time edition), and nothing is ever dropped.
+
+        Exactly one source: ``variables`` (a ready pytree),
+        ``workdir`` (restore the latest checkpoint), or ``perturb``
+        (current weights + a float constant — the smoke-drill path:
+        guarantees a new fingerprint without a second checkpoint).
+
+        Pipelines that use this model as a STAGE keep serving the
+        weights they warmed with (their DAG runners captured the old
+        edition at compile time) until re-registered — the front-door
+        path for ``name`` swaps; DAGs are deliberately immutable."""
+        served = self._models.get(name)
+        if served is None:
+            raise ValueError(f"unknown model {name!r}; serving "
+                             f"{sorted(self._models)}")
+        if getattr(served, "is_pipeline", False) \
+                or getattr(served, "is_stateful", False):
+            kind = ("pipeline" if getattr(served, "is_pipeline", False)
+                    else "stateful wrapper")
+            raise ValueError(
+                f"{name!r} is a {kind}; hot-swap targets its stage "
+                "models' front doors")
+        if served.variables is None:
+            raise ValueError(
+                f"{name!r} is a StableHLO artifact (weights baked into "
+                "the program); register a new artifact instead")
+        if sum(v is not None for v in (variables, workdir, perturb)) != 1:
+            raise ValueError(
+                "pass exactly one of variables=, workdir=, perturb=")
+        if workdir is not None:
+            from deepvision_tpu.serve.models import (
+                _state_variables,
+                model_geometry,
+                restore_state,
+            )
+
+            size, ch = model_geometry(name)
+            state = restore_state(
+                name, workdir, np.zeros((1, size, size, ch), np.float32))
+            variables = _state_variables(state)
+        if perturb is not None:
+            import jax
+
+            def _nudge(a):
+                a = np.asarray(a)
+                if np.issubdtype(a.dtype, np.floating):
+                    return (a + perturb).astype(a.dtype)
+                return a
+
+            variables = jax.tree_util.tree_map(
+                _nudge, served.edition.variables)
+        result = self._tenancy.swap(
+            served, variables, ladder=self.ladder(served),
+            mesh=self._mesh, cache=self._cache,
+            key_fn=self._model_key)
+        if self._store is not None and self._storeable(served):
+            # keep the store current: a replica respawned after the
+            # swap warms the NEW fingerprint from disk
+            for bucket in self.ladder(served):
+                self._save_store_entry(served, bucket)
+        return result
+
+    def _bucket_runner(self, served, bucket: int):
+        """The cached executable for (model, bucket) with swap
+        consistency: if a hot-swap flips the weights edition between
+        the key read and the cache lookup, retry — the runner an
+        executable key names must always pair with the weights
+        generation in that key (satellite-bugfix contract)."""
+        while True:
+            key = self._model_key(served, bucket)
+            runner = self._cache.get_or_build(
+                key, lambda: served.compile_for(bucket, self._mesh))
+            if key == self._model_key(served, bucket):
+                return runner
+
+    @property
+    def tenancy(self):
+        """The engine's :class:`~deepvision_tpu.serve.tenancy.
+        TenancyManager` (always present; budget-less by default) —
+        ``serve.py`` prints its grep-stable summary line at exit."""
+        return self._tenancy
 
     # -- client surface --------------------------------------------------
     def submit(self, x, model: str | None = None, *,
@@ -371,8 +616,13 @@ class InferenceEngine:
             "health": self.health(),
             "queue": self._admission.stats(),
             "cache": self._cache.stats(),
+            "tenancy": self._tenancy.stats(),
+            "warmed_from_store": sorted(
+                f"{k[0]}@{k[1]}" for k in self._from_store),
             "telemetry": self.telemetry.snapshot(),
         }
+        if self._store is not None:
+            out["artifact_store"] = self._store.stats()
         stores = self._session_stores()
         if stores:
             out["sessions"] = {name: s.stats()
@@ -633,10 +883,11 @@ class InferenceEngine:
         for i, r in enumerate(reqs):
             x[i] = r.x
         try:
-            runner = self._cache.get_or_build(
-                (served.name, bucket, served.dtype_str),
-                lambda: served.compile_for(bucket, self._mesh),
-            )
+            # residency first: a cold tenant's weights come back to the
+            # device (and LRU victims leave) BEFORE the executable runs
+            for tn in self._tenant_names(served):
+                self._tenancy.ensure_resident(tn)
+            runner = self._bucket_runner(served, bucket)
             xd = jax.device_put(x, data_sharding(self._mesh, x.ndim))
             t0 = time.perf_counter()
             host = jax.device_get(runner(xd))
@@ -774,10 +1025,9 @@ class InferenceEngine:
         for i, (r, _f) in enumerate(group):
             x[i] = r.x
         try:
-            runner = self._cache.get_or_build(
-                (served.name, bucket, served.dtype_str),
-                lambda: served.compile_for(bucket, self._mesh),
-            )
+            for tn in self._tenant_names(served):
+                self._tenancy.ensure_resident(tn)
+            runner = self._bucket_runner(served, bucket)
             zero = runner.zero_slates()
             # stack per-session device rows (zero rows for fresh/reset
             # streams and padding) into the batched slate pytree

@@ -150,10 +150,32 @@ class ServedModel:
     precompiled: Callable | None = None
     _direct: Callable | None = dataclasses.field(
         default=None, repr=False, compare=False)
+    # tenancy: the live WeightsEdition (``serve.tenancy``) once the
+    # engine adopts this model as a tenant. Runners compiled while an
+    # edition is attached read weights through it at call time, which
+    # is what makes LRU eviction and zero-drop hot-swap possible.
+    edition: Any = dataclasses.field(
+        default=None, repr=False, compare=False)
+    _fingerprint: str | None = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     @property
     def dtype_str(self) -> str:
         return str(np.dtype(self.input_dtype))
+
+    def weights_fingerprint(self) -> str:
+        """Content hash of the weights (cached): the compile-cache /
+        artifact-store key component that keeps an executable compiled
+        against one weights generation from ever pairing with another.
+        Content-derived, so a respawned replica restoring the same
+        checkpoint agrees with the store on disk."""
+        if self.edition is not None:
+            return self.edition.fingerprint
+        if self._fingerprint is None:
+            from deepvision_tpu.serve.tenancy import fingerprint_variables
+
+            self._fingerprint = fingerprint_variables(self.variables)
+        return self._fingerprint
 
     # -- engine path -----------------------------------------------------
     def as_stage(self):
@@ -161,14 +183,21 @@ class ServedModel:
         carrying the pure forward + variables + explicit input/output
         avals — what a serving DAG composes. ``compile_for`` delegates
         here so the single-model and pipeline paths share one AOT
-        compile recipe."""
+        compile recipe. The stage snapshots the CURRENT weights edition:
+        runners compiled from it read that edition at call time."""
         from deepvision_tpu.serve.pipeline import ModelStage
 
+        ed = self.edition
         return ModelStage(
             name=self.name, forward=self.forward,
             variables=self.variables, input_shape=self.input_shape,
             input_dtype=self.input_dtype, precompiled=self.precompiled,
             pinned_buckets=self.buckets,
+            variables_ref=(lambda: ed.variables) if ed is not None
+            else None,
+            # config-time hash of host weights (cached after first
+            # call), not a fetch on the DAG execution path
+            fingerprint=self.weights_fingerprint(),  # jaxlint: disable=JX127
         )
 
     def in_avals(self, bucket: int):
@@ -187,6 +216,23 @@ class ServedModel:
         ``x_device -> device outputs``. StableHLO-backed models return
         their deserialized executable (already compiled, one shape)."""
         return self.as_stage().compile(bucket, mesh, donate=True)
+
+    def export_bytes(self, bucket: int) -> bytes:
+        """Serialize the whole request program at ``bucket`` —
+        forward + in-graph post-processing with the CURRENT weights
+        baked in as constants — as StableHLO bytes. What the serve
+        artifact store persists (keyed by this model's
+        ``weights_fingerprint``), so a fresh replica deserializes
+        instead of re-tracing."""
+        from deepvision_tpu.export import export_callable
+
+        variables = self.variables
+        forward = self.forward
+
+        def fn(x):
+            return forward(variables, x)
+
+        return export_callable(fn, self.in_avals(bucket))
 
     # -- direct (engine-less) path: the one-shot CLI ---------------------
     def run(self, batch) -> Any:

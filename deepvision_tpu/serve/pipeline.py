@@ -93,6 +93,15 @@ class ModelStage:
     input_dtype: Any = np.float32
     precompiled: Callable | None = None
     pinned_buckets: tuple[int, ...] | None = None
+    # tenancy seam: when set, runners read the live weights through
+    # this zero-arg callable at CALL time instead of capturing
+    # ``variables`` at compile time — the weights edition indirection
+    # that lets eviction free HBM (the edition holds the only device
+    # refs) while a hot-swap's old runners drain on their compile-time
+    # edition. ``fingerprint`` is the weights content hash the compile
+    # cache keys on (``"static"`` for stages outside tenancy).
+    variables_ref: Callable | None = None
+    fingerprint: str = "static"
 
     @property
     def dtype_str(self) -> str:
@@ -147,10 +156,18 @@ class ModelStage:
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             compiled = fn.lower(self.variables, x_spec).compile()
-        variables = self.variables
+        get = self.variables_ref
+        if get is None:
+            variables = self.variables
 
-        def runner(x_device):
-            return compiled(variables, x_device)
+            def runner(x_device):
+                return compiled(variables, x_device)
+        else:
+            def runner(x_device):
+                # call-time read through the compile-time edition: the
+                # local ref pins the device buffers for exactly this
+                # call, so a concurrent evict/swap never tears a batch
+                return compiled(get(), x_device)
 
         return runner
 
@@ -675,8 +692,9 @@ class Pipeline:
         """Chunk ``rows`` inter-stage rows through this stage's own
         ladder; every chunk executable (and the pad program for the
         ragged tail) compiles through the shared cache. Stage
-        executables are keyed ``(pipeline:model, bucket, dtype)`` —
-        distinct from the engine's front-door key because pipeline
+        executables are keyed ``(pipeline:model, bucket, dtype,
+        weights fingerprint)`` — distinct from the engine's front-door
+        key because pipeline
         stages compile WITHOUT input donation (inter-stage buffers can
         have several consumers)."""
         import jax
@@ -687,7 +705,8 @@ class Pipeline:
         cache = self._cache
         runners = {}
         for _start, k, b in plan:
-            key = (f"pipeline:{stage.name}", b, stage.dtype_str)
+            key = (f"pipeline:{stage.name}", b, stage.dtype_str,
+                   stage.fingerprint)
             runners[b] = cache.get_or_build(
                 key, lambda b=b: stage.compile(b, mesh, donate=False))
             if k < b:

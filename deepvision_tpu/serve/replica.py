@@ -398,9 +398,15 @@ class ProcessReplica:
 
 def replica_argv(model_specs: list[str], *, buckets: str | None = None,
                  artifact_specs: list[str] | None = None,
+                 store: str | None = None,
                  extra: list[str] | None = None) -> list[str]:
     """argv for a ``ProcessReplica`` child: this interpreter running the
-    repo's ``serve.py`` in HTTP mode on an ephemeral port."""
+    repo's ``serve.py`` in HTTP mode on an ephemeral port.
+
+    ``store``: a shared AOT artifact-store directory (``--store``) —
+    every child of the fleet warms its executables from the same disk
+    cache, so a respawned replica skips the compile storm the first
+    generation paid."""
     serve_py = Path(__file__).resolve().parent.parent.parent / "serve.py"
     argv = [sys.executable, str(serve_py), "--http", "0"]
     for spec in model_specs:
@@ -409,5 +415,7 @@ def replica_argv(model_specs: list[str], *, buckets: str | None = None,
         argv += ["--artifact", spec]
     if buckets:
         argv += ["--buckets", buckets]
+    if store:
+        argv += ["--store", str(store)]
     argv += list(extra or [])
     return argv

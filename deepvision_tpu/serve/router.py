@@ -333,7 +333,10 @@ class FleetRouter:
     through the same factory after a death. ``slo`` maps model name ->
     p95 deadline budget in seconds: it becomes both the model's default
     request deadline and its admission budget (see
-    ``AdmissionController.slo_budget_s``).
+    ``AdmissionController.slo_budget_s``). ``tenant_quota`` /
+    ``slo_class`` thread the multi-tenant isolation story into the
+    router's own admission gate — a noisy tenant sheds at the FLEET
+    front door before it can crowd any replica's queue.
     """
 
     def __init__(
@@ -358,6 +361,8 @@ class FleetRouter:
         telemetry: RouterTelemetry | None = None,
         start: bool = True,
         session_replay_window: int = 32,
+        tenant_quota: dict[str, int] | None = None,
+        slo_class: dict[str, str] | None = None,
     ):
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
@@ -376,7 +381,8 @@ class FleetRouter:
             else RouterTelemetry()
         self._admission = AdmissionController(
             max_queue=max_queue, per_model_limit=per_model_limit,
-            slo_budget_s=self._slo or None)
+            slo_budget_s=self._slo or None,
+            tenant_quota=tenant_quota, slo_class=slo_class)
         self._injector = fault_injector
         self._lock = threading.Lock()
         self._session_replay_window = max(0, int(session_replay_window))

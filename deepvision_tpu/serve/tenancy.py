@@ -1,0 +1,290 @@
+"""Multi-tenant weight residency + zero-drop hot-swap.
+
+N registry models / StableHLO artifacts share one replica's HBM. The
+mechanism underneath everything is the **weights edition**: a small
+mutable cell holding one generation of a tenant's weights plus its
+content fingerprint. Compiled runners capture the edition object at
+compile time and read ``edition.variables`` at *call* time
+(``pipeline.ModelStage.variables_ref``), which buys both halves of the
+tenancy story at once:
+
+- **LRU residency**: evicting a cold tenant replaces
+  ``edition.variables`` with host copies — the edition held the only
+  strong refs to the device buffers, so HBM is actually freed even
+  though compiled executables for that tenant stay cached.
+  Re-materializing is one ``device_put`` back into the same edition:
+  no recompile, and every cached runner sees the device weights again.
+- **Zero-drop hot-swap**: a swap builds a NEW edition and pre-compiles
+  the whole bucket ladder against it off the dispatch path, then flips
+  the tenant's edition pointer atomically between batches. Old runners
+  keep their compile-time edition, so requests already queued against
+  the pre-swap executables drain on the pre-swap weights — no drops,
+  no torn weight/executable pairing. The compile-cache key carries the
+  fingerprint, so the flip is a cache *miss* into the freshly
+  installed entries, never a stale hit.
+
+Per-tenant isolation (admission quotas, SLO classes, shed accounting)
+lives in ``admission.AdmissionController`` — the engine and
+``FleetRouter`` thread tenant maps through it so one noisy tenant
+sheds alone.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+__all__ = ["TenancyManager", "WeightsEdition", "fingerprint_variables",
+           "tree_nbytes"]
+
+
+def fingerprint_variables(variables) -> str:
+    """Content hash of a weights pytree (structure + leaf bytes),
+    truncated sha256. Content-derived on purpose: a respawned replica
+    restoring the same checkpoint computes the same fingerprint, so
+    artifact-store keys match across process generations. ``None``
+    (StableHLO artifacts: weights baked into the program) hashes to
+    the sentinel ``"artifact"``."""
+    if variables is None:
+        return "artifact"
+    import jax
+
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten(variables)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        a = np.asarray(leaf)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def tree_nbytes(variables) -> int:
+    import jax
+
+    return sum(int(np.asarray(leaf).nbytes)
+               for leaf in jax.tree_util.tree_leaves(variables))
+
+
+class WeightsEdition:
+    """One generation of a tenant's weights. Identity is the unit of
+    hot-swap isolation: runners compiled against this edition read
+    ``variables`` through it forever, so mutating the cell (evict /
+    re-materialize) retargets every cached executable at once, while a
+    swap — a *new* edition — retargets none of them."""
+
+    __slots__ = ("variables", "fingerprint", "nbytes", "resident")
+
+    def __init__(self, variables, fingerprint: str, nbytes: int,
+                 *, resident: bool):
+        self.variables = variables
+        self.fingerprint = fingerprint
+        self.nbytes = nbytes
+        self.resident = resident
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"WeightsEdition({self.fingerprint}, "
+                f"{self.nbytes}B, resident={self.resident})")
+
+
+class TenancyManager:
+    """LRU weight residency + hot-swap for one engine's tenants.
+
+    ``budget_bytes`` caps the summed resident weight bytes; the
+    least-recently-dispatched tenants beyond it are evicted to host.
+    ``None`` disables eviction (every tenant stays resident — the
+    pre-tenancy behavior). All counters are grep-stable via
+    :meth:`summary_line`.
+    """
+
+    def __init__(self, mesh, *, budget_bytes: int | None = None,
+                 log=print):
+        self._mesh = mesh
+        self._budget = budget_bytes
+        self._log = log
+        self._lock = threading.RLock()
+        # serializes swaps only: ladder pre-compiles are slow and must
+        # not hold the residency lock the dispatcher takes per batch
+        self._swap_lock = threading.Lock()
+        self._tenants: dict[str, Any] = {}
+        self._lru: OrderedDict[str, None] = OrderedDict()
+        self.swaps = 0
+        self.evictions = 0
+        self.rematerializations = 0
+
+    # -- registration -----------------------------------------------------
+    def adopt(self, served) -> None:
+        """Register a tenant: fingerprint its (host) weights, stage
+        them onto the mesh, and hang a :class:`WeightsEdition` off the
+        model so every runner compiled from here on reads weights
+        through the edition (``ServedModel.as_stage`` threads it)."""
+        with self._lock:
+            if served.name in self._tenants:
+                return
+            self._tenants[served.name] = served
+            if served.variables is None:
+                return  # artifact tenant: weights live in the program
+            fp = served.weights_fingerprint()
+            ed = WeightsEdition(
+                self._stage_weights(served.variables), fp,
+                tree_nbytes(served.variables), resident=True)
+            served.edition = ed
+            served.variables = ed.variables
+            self._lru[served.name] = None
+            self._evict_over_budget(protect=served.name)
+
+    def _stage_weights(self, variables):
+        """One replicated ``device_put`` of a whole weights pytree —
+        the residency manager is the ONE place weights cross to the
+        device (JX129 polices strays in dispatch loops)."""
+        import jax
+
+        from deepvision_tpu.core.mesh import replicated_sharding
+
+        return jax.device_put(variables, replicated_sharding(self._mesh))
+
+    # -- residency --------------------------------------------------------
+    def ensure_resident(self, name: str) -> None:
+        """Dispatch-path hook: touch the tenant's LRU slot and
+        re-materialize its weights if a prior eviction moved them to
+        host. Cheap when already resident (dict touch under lock)."""
+        with self._lock:
+            served = self._tenants.get(name)
+            if served is None or served.edition is None:
+                return
+            if not served.edition.resident:
+                self._rematerialize(served)
+            self._lru[name] = None
+            self._lru.move_to_end(name)
+            self._evict_over_budget(protect=name)
+
+    def _rematerialize(self, served) -> None:
+        ed = served.edition
+        ed.variables = self._stage_weights(ed.variables)
+        served.variables = ed.variables
+        ed.resident = True
+        self.rematerializations += 1
+        self._log(f"[tenancy] rematerialized {served.name} "
+                  f"({ed.nbytes}B)", flush=True)
+
+    def evict(self, name: str) -> bool:
+        """Move one tenant's weights to host. The edition held the
+        only strong refs to the device buffers (runners read through
+        it at call time, and a batch mid-flight keeps its own ref for
+        the call's duration), so this actually frees HBM while every
+        compiled executable stays warm in the cache."""
+        with self._lock:
+            served = self._tenants.get(name)
+            if (served is None or served.edition is None
+                    or not served.edition.resident):
+                return False
+            import jax
+
+            ed = served.edition
+            ed.variables = jax.tree_util.tree_map(
+                lambda a: np.asarray(a), ed.variables)
+            served.variables = ed.variables
+            ed.resident = False
+            self._lru.pop(name, None)
+            self.evictions += 1
+            self._log(f"[tenancy] evicted {name} ({ed.nbytes}B) to host",
+                      flush=True)
+            return True
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                t.edition.nbytes for t in self._tenants.values()
+                if t.edition is not None and t.edition.resident)
+
+    def _evict_over_budget(self, *, protect: str | None = None) -> None:
+        if self._budget is None:
+            return
+        while self.resident_bytes() > self._budget:
+            victim = next((n for n in self._lru if n != protect), None)
+            if victim is None:
+                break  # the protected tenant alone exceeds the budget
+            self.evict(victim)
+
+    # -- hot-swap ---------------------------------------------------------
+    def swap(self, served, new_variables, *, ladder, mesh, cache,
+             key_fn) -> dict:
+        """Zero-drop weight hot-swap. Everything slow — staging the
+        new weights, pre-compiling every ladder bucket — happens on
+        the caller's thread against a NEW edition while the dispatcher
+        keeps serving the old one. The flip is an atomic pointer swap
+        under the residency lock: install the new executables in the
+        cache first, then repoint the tenant, so the dispatcher's
+        per-batch (fingerprint -> runner) read always pairs weights
+        with the executable compiled for them. Old runners keep their
+        compile-time edition and drain untouched."""
+        import dataclasses
+
+        with self._swap_lock:
+            old_fp = served.weights_fingerprint()
+            fp = fingerprint_variables(new_variables)
+            new_ed = WeightsEdition(
+                self._stage_weights(new_variables), fp,
+                tree_nbytes(new_variables), resident=True)
+            # shadow model: same surface, new edition — what the
+            # ladder pre-compiles and the store exports against
+            shadow = dataclasses.replace(
+                served, variables=new_ed.variables, edition=new_ed,
+                _fingerprint=fp, _direct=None)
+            runners = {}
+            for bucket in ladder:
+                runners[key_fn(shadow, bucket)] = shadow.compile_for(
+                    bucket, mesh)
+            with self._lock:
+                for key, runner in runners.items():
+                    cache.install(key, runner)
+                served.edition = new_ed
+                served.variables = new_ed.variables
+                served._fingerprint = fp
+                self._lru[served.name] = None
+                self._lru.move_to_end(served.name)
+                self.swaps += 1
+            # hygiene, outside the dispatch-path lock: executables for
+            # the old fingerprint can never be *hit* again (the key
+            # changed), so drop them; a batch mid-flight holds its own
+            # runner reference and drains regardless
+            dropped = cache.drop_where(
+                lambda k: k[0] == served.name and len(k) > 3
+                and k[3] == old_fp)
+            self._evict_over_budget(protect=served.name)
+            self._log(f"[tenancy] swapped {served.name}: {old_fp} -> "
+                      f"{fp} ({len(runners)} buckets, "
+                      f"{dropped} stale executables dropped)", flush=True)
+            return {"model": served.name, "fingerprint": fp,
+                    "old_fingerprint": old_fp,
+                    "buckets": [int(b) for b in ladder],
+                    "dropped_executables": int(dropped)}
+
+    # -- observability ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "tenants": sorted(self._tenants),
+                "resident": [n for n, t in sorted(self._tenants.items())
+                             if t.edition is not None
+                             and t.edition.resident],
+                "resident_bytes": self.resident_bytes(),
+                "budget_bytes": self._budget,
+                "swaps": self.swaps,
+                "evictions": self.evictions,
+                "rematerializations": self.rematerializations,
+            }
+
+    def summary_line(self) -> str:
+        """Grep-stable exit line (``serve.py`` prints it at shutdown;
+        ``make swap-smoke`` asserts on it)."""
+        return (f"[tenancy] swaps={self.swaps} "
+                f"evictions={self.evictions} "
+                f"rematerializations={self.rematerializations} "
+                f"resident={len(self.stats()['resident'])}"
+                f"/{len(self._tenants)}")

@@ -55,6 +55,21 @@ def _parse_spec(spec: str) -> tuple[str, str | None]:
     return name, (workdir or None)
 
 
+def _parse_tenant_map(specs, *, flag: str, cast):
+    """``NAME=VALUE`` repeatable flags -> dict (tenant isolation maps:
+    ``--tenant-quota lenet5=8``, ``--slo-class lenet5=gold``)."""
+    out = {}
+    for spec in specs or []:
+        name, sep, val = spec.partition("=")
+        if not sep or not name:
+            sys.exit(f"bad {flag} spec {spec!r}; want NAME=VALUE")
+        try:
+            out[name] = cast(val)
+        except ValueError as e:
+            sys.exit(f"bad {flag} spec {spec!r}: {e}")
+    return out or None
+
+
 def build_engine(args):
     from deepvision_tpu.serve import InferenceEngine, from_stablehlo
     from deepvision_tpu.serve.models import load_served
@@ -151,9 +166,23 @@ def build_engine(args):
         # later miss (a hidden request-time compile) raises instead of
         # silently costing tail latency
         freeze_cache=bool(pipelines),
+        store=getattr(args, "store", None),
+        residency_bytes=(int(args.residency_mb * 1024 * 1024)
+                         if getattr(args, "residency_mb", None)
+                         else None),
+        tenant_quota=_parse_tenant_map(
+            getattr(args, "tenant_quota", None),
+            flag="--tenant-quota", cast=int),
+        slo_class=_parse_tenant_map(
+            getattr(args, "slo_class", None),
+            flag="--slo-class", cast=str),
     )
+    stats = engine.stats()
+    from_store = stats.get("warmed_from_store") or []
     print(f"warmup done in {engine.warmup_s}s "
-          f"({engine.stats()['cache']['entries']} executables)",
+          f"({stats['cache']['entries']} executables"
+          + (f", {len(from_store)} from store" if from_store else "")
+          + ")",
           file=sys.stderr)
     return engine
 
@@ -207,12 +236,22 @@ def build_fleet(args):
     child_argv = replica_argv(
         args.model or [], artifact_specs=args.artifact or [],
         buckets=args.buckets,
+        # shared AOT store: replica #1 traces and populates it, every
+        # later (re)spawn warms from disk — the respawn compile storm
+        # PR 6 measured is paid once per fleet, not once per process
+        store=args.store,
         extra=(["--num-classes", str(args.num_classes)]
                if args.num_classes is not None else [])
         + ["--top", str(args.top), "--score", str(args.score),
            "--max-queue", str(args.max_queue),
            "--batch-window-ms", str(args.batch_window_ms),
            "--timeout-s", str(args.timeout_s)]
+        + [a for spec in (args.tenant_quota or [])
+           for a in ("--tenant-quota", spec)]
+        + [a for spec in (args.slo_class or [])
+           for a in ("--slo-class", spec)]
+        + (["--residency-mb", str(args.residency_mb)]
+           if args.residency_mb else [])
         + [a for path in (args.pipelines or [])
            for a in ("--pipelines", path)]
         + (["--track", args.track, "--session-dir", session_dir,
@@ -272,6 +311,12 @@ def build_fleet(args):
         per_model_limit=args.per_model_limit, autoscale=autoscale,
         hedge_after_s=args.hedge_after, fault_injector=injector,
         session_replay_window=args.session_replay_window,
+        # tenant isolation at the FLEET front door too: a noisy tenant
+        # sheds here before it can crowd any replica's queue
+        tenant_quota=_parse_tenant_map(
+            args.tenant_quota, flag="--tenant-quota", cast=int),
+        slo_class=_parse_tenant_map(
+            args.slo_class, flag="--slo-class", cast=str),
     )
     print(f"fleet up: {router.health()}", file=sys.stderr)
     return router
@@ -334,13 +379,54 @@ def _jsonable(obj):
 def run_stdin(engine, args, stdin=None, stdout=None):
     """One JSON request per line; responses (in submission order) to
     stdout. Requests keep flowing while earlier ones execute, so the
-    dispatcher sees real micro-batches even from a pipe."""
+    dispatcher sees real micro-batches even from a pipe.
+
+    Control lines ride the same stream: ``{"control": "swap",
+    "model": NAME, "perturb": F | "workdir": DIR}`` hot-swaps a
+    tenant's weights on a background thread while data lines keep
+    flowing — the swap-smoke drill's zero-drop evidence. Control
+    lines produce stderr chatter only (stdout stays a pure
+    data-response stream); the ``[tenancy]`` exit line carries the
+    swap count."""
+    import contextlib
+    import threading
     import time
 
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
 
     pending: list[tuple[object, object, float]] = []  # (id, future, t0)
+    control_threads: list[threading.Thread] = []
+
+    def start_control(req: dict) -> None:
+        if req.get("control") != "swap":
+            print(f"[tenancy] unknown control {req.get('control')!r}",
+                  file=sys.stderr, flush=True)
+            return
+        hot_swap = getattr(engine, "hot_swap", None)
+        if hot_swap is None:
+            print("[tenancy] swap control needs a single-engine host "
+                  "(fleet routers don't own weights)",
+                  file=sys.stderr, flush=True)
+            return
+
+        def _do_swap():
+            kw = {k: req[k] for k in ("workdir", "perturb")
+                  if k in req}
+            try:
+                # checkpoint-restore chatter must not pollute the
+                # stdout data stream
+                with contextlib.redirect_stdout(sys.stderr):
+                    hot_swap(req["model"], **kw)
+            except Exception as e:
+                print(f"[tenancy] swap {req.get('model')!r} failed: "
+                      f"{type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+
+        t = threading.Thread(target=_do_swap, daemon=True,
+                             name="tenancy-swap")
+        t.start()
+        control_threads.append(t)
 
     def emit(rid, fut, t0):
         try:
@@ -365,6 +451,9 @@ def run_stdin(engine, args, stdin=None, stdout=None):
             req = json.loads(raw)
             if not isinstance(req, dict):
                 raise ValueError("request must be a JSON object")
+            if "control" in req:
+                start_control(req)
+                continue
             x = np.asarray(req["input"], np.float32)
             # stateful streams: session (stream id) + seq (frame no.)
             seq = req.get("seq")
@@ -399,6 +488,10 @@ def run_stdin(engine, args, stdin=None, stdout=None):
             emit(*pending.pop(0))
     for item in pending:
         emit(*item)
+    for t in control_threads:
+        # a swap started near EOF still completes (and is counted in
+        # the [tenancy] exit line) before the engine closes
+        t.join(timeout=args.timeout_s)
 
 
 # ----------------------------------------------------------------- HTTP
@@ -497,6 +590,9 @@ def make_handler(engine, args):
             # the engine serves pipelines through the same submit path
             # as models, so past this point the request is ordinary
             pipeline = None
+            if self.path == "/v1/swap":
+                self._do_swap()
+                return
             if self.path.startswith("/v1/pipeline/"):
                 pipeline = self.path[len("/v1/pipeline/"):]
                 if not pipeline:
@@ -565,6 +661,42 @@ def make_handler(engine, args):
                 self._send(500, {"error": str(e)})
                 return
             self._send(200, {"result": _jsonable(result)})
+
+        def _do_swap(self):
+            """POST /v1/swap {"model": NAME, "perturb": F |
+            "workdir": DIR}: zero-drop weight hot-swap. Synchronous —
+            the 200 means the new ladder is compiled, installed, and
+            flipped; in-flight requests drained on the old weights.
+            Other handler threads keep serving throughout (the flip
+            happens between dispatcher batches, not here)."""
+            hot_swap = getattr(engine, "hot_swap", None)
+            if hot_swap is None:
+                self._send(404, {"error": "swap needs a single-engine "
+                                 "replica (fleet routers don't own "
+                                 "weights)"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                if not isinstance(req, dict) or "model" not in req:
+                    raise ValueError("need a JSON object with 'model'")
+                kw = {k: req[k] for k in ("workdir", "perturb")
+                      if k in req}
+            except (ValueError, KeyError, TypeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            import contextlib
+
+            try:
+                with contextlib.redirect_stdout(sys.stderr):
+                    result = hot_swap(req["model"], **kw)
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+                return
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {"result": result})
 
     return Handler
 
@@ -720,6 +852,28 @@ def main(argv=None):
                         "stream to replay the snapshot->present gap "
                         "after a failover; a gap wider than this "
                         "degrades to a DECLARED state_reset")
+    p.add_argument("--store", default=None, metavar="DIR",
+                   help="persistent AOT artifact store: warm "
+                        "executables from this directory's verified "
+                        "StableHLO blobs instead of re-tracing (cold "
+                        "misses trace and populate it); fleet mode "
+                        "shares the DIR across replicas so respawns "
+                        "skip the compile storm")
+    p.add_argument("--residency-mb", type=float, default=None,
+                   help="HBM budget for resident tenant weights in "
+                        "MiB: least-recently-served tenants beyond it "
+                        "are evicted to host and re-materialized on "
+                        "demand (default: everything stays resident)")
+    p.add_argument("--tenant-quota", action="append", metavar="NAME=N",
+                   help="per-tenant admission quota (max queued "
+                        "requests), repeatable — a noisy tenant sheds "
+                        "alone at its own cap")
+    p.add_argument("--slo-class", action="append",
+                   metavar="NAME=CLASS",
+                   help="per-tenant SLO class (gold/standard/batch), "
+                        "repeatable: under contention a tenant only "
+                        "occupies its class's fraction of the queue "
+                        "(1.0/0.8/0.5); alone it gets the whole host")
     p.add_argument("--num-classes", type=int, default=None)
     p.add_argument("--top", type=int, default=5)
     p.add_argument("--score", type=float, default=0.5)
@@ -793,6 +947,9 @@ def main(argv=None):
             print(f"[pipeline] served {served} "
                   f"frozen={cache['frozen']} misses={cache['misses']} "
                   f"hits={cache['hits']}", file=sys.stderr, flush=True)
+        # grep-stable tenancy exit line: the swap smoke gate asserts
+        # swaps=N on it (and zero dropped data responses upstream)
+        print(engine.tenancy.summary_line(), file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

@@ -329,6 +329,11 @@ _SLOW_TESTS = {
     # in the fast tier, and `make chaos-sdc-smoke` runs the real path
     # in `make check`
     "test_two_host_sdc_quarantine_end_to_end",
+    # tenancy (ISSUE 20): the real serve.py respawn-from-store drill
+    # spawns two sequential lenet5 children; the in-process store /
+    # swap / residency tests cover the logic in the fast tier, and
+    # `make swap-smoke` runs the real path in `make check`
+    "test_process_replica_respawn_warms_from_store",
 }
 # whole modules that spawn real subprocesses (jax.distributed workers)
 _SLOW_MODULES = {"test_distributed"}

@@ -2201,6 +2201,92 @@ def test_load_config_reads_session_funcs(tmp_path):
     assert "*frame_loop*" in LintConfig().session_funcs
 
 
+# ----------------------------------------------------------- JX129
+
+
+def test_jx129_flags_weight_upload_in_request_loop(tmp_path):
+    r = lint(tmp_path, "serve/dispatch.py", """
+        import jax
+
+        def dispatch_loop(requests, model, sharding):
+            for req in requests:
+                variables = jax.device_put(model.variables, sharding)
+                self_params = jax.device_put(req.lora_params, sharding)
+                yield apply(variables, self_params, req.x)
+        """)
+    assert codes(r) == ["JX129", "JX129"]
+    assert "residency" in r.findings[0].message
+    assert "variables" in r.findings[0].message
+
+
+def test_jx129_passes_residency_manager_and_non_weights(tmp_path):
+    # the sanctioned staging paths (residency_funcs names) are exempt,
+    # and device_put of non-weight values in a loop is not a finding
+    r = lint(tmp_path, "serve/dispatch.py", """
+        import jax
+
+        def ensure_resident(tenants, sharding):
+            for t in tenants:
+                t.variables = jax.device_put(t.host_variables, sharding)
+            return tenants
+
+        def _rematerialize_all(editions, sharding):
+            for ed in editions:
+                ed.variables = jax.device_put(ed.variables, sharding)
+
+        def dispatch_loop(requests, sharding):
+            for req in requests:
+                x = jax.device_put(req.batch, sharding)  # data, fine
+                yield run(x)
+        """)
+    assert codes(r) == []
+
+
+def test_jx129_upload_outside_loop_not_flagged(tmp_path):
+    # a one-time staging before the loop is exactly the amortized
+    # pattern the checker wants — only per-request uploads are hazards
+    r = lint(tmp_path, "serve/dispatch.py", """
+        import jax
+
+        def dispatch_loop(requests, model, sharding):
+            variables = jax.device_put(model.variables, sharding)
+            for req in requests:
+                yield apply(variables, req.x)
+        """)
+    assert codes(r) == []
+
+
+def test_jx129_residency_funcs_knob_overrides(tmp_path):
+    cfg = LintConfig(residency_funcs=["pin_tenant*"])
+    r = lint(tmp_path, "lib/mux.py", """
+        import jax
+
+        def pin_tenant_weights(tenants, sharding):
+            for t in tenants:
+                t.variables = jax.device_put(t.variables, sharding)
+
+        def ensure_resident(tenants, sharding):
+            for t in tenants:
+                t.variables = jax.device_put(t.variables, sharding)
+        """, cfg=cfg)
+    # with the knob overridden, ensure_resident is no longer sanctioned
+    assert codes(r) == ["JX129"]
+    assert "ensure_resident" in r.findings[0].message
+
+
+def test_load_config_reads_residency_funcs(tmp_path):
+    import textwrap as _tw
+
+    p = tmp_path / "jaxlint.toml"
+    p.write_text(_tw.dedent("""
+        [jaxlint]
+        residency_funcs = ["pin_tenant*"]
+        """))
+    cfg = load_config(p)
+    assert cfg.residency_funcs == ["pin_tenant*"]
+    assert "*rematerialize*" in LintConfig().residency_funcs
+
+
 # ------------------------------- concurrency tier (ISSUE 14, JX118-122)
 
 

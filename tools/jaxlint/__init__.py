@@ -101,6 +101,11 @@ Checker codes (tools/jaxlint/checkers.py):
            loop of a stream-handling function (``session_funcs``
            knob) — session state stays device-resident between
            frames; the stateful batch path does ONE fetch per batch
+    JX129  jax.device_put of a weights/params/variables pytree inside
+           a dispatch/request loop outside a residency manager
+           (``residency_funcs`` knob) — weights are staged ONCE by
+           the tenancy layer; per-request uploads re-introduce the
+           full checkpoint transfer on the hot path
 
 Suppression: append ``# jaxlint: disable=JX103`` to the offending line
 (or the line above), or record a repo-level exception in ``jaxlint.toml``

@@ -1846,6 +1846,89 @@ class SessionHostRoundTripChecker(Checker):
                                 "pipeline the session store removes")
 
 
+@register_checker
+class WeightUploadInRequestLoopChecker(Checker):
+    """Per-request ``jax.device_put`` of a weight pytree inside a
+    dispatch/request loop: multi-tenant residency (``serve/tenancy.py``)
+    stages each tenant's weights onto the device ONCE — adopt /
+    ensure_resident / rematerialize, amortized behind the LRU budget —
+    and every dispatch after that reads the resident edition.
+    Re-uploading ``variables``/``weights``/``params`` per request
+    re-introduces the full checkpoint transfer (HBM churn + PCIe
+    stall) on the hot path the residency manager exists to protect;
+    results stay correct, only the cost model breaks, so nothing else
+    catches it. Functions whose NAME matches the ``residency_funcs``
+    knob (``jaxlint.toml``) are the sanctioned staging paths and are
+    exempt; everything else that loops over requests and device_puts a
+    weights-named pytree is flagged."""
+
+    code = "JX129"
+    name = "weight-upload-in-request-loop"
+    description = ("jax.device_put of a weights/params/variables pytree "
+                   "inside a dispatch/request loop outside a residency "
+                   "manager (re-uploads the checkpoint per request)")
+
+    WEIGHT_NAMES = {"variables", "weights", "params"}
+
+    @classmethod
+    def _weighty(cls, node: ast.AST) -> str | None:
+        """Dotted-name tail of ``node`` if it names a weight pytree."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        if not parts:
+            return None
+        tail = parts[0]  # last dotted segment (e.g. self.model.params)
+        if tail in cls.WEIGHT_NAMES:
+            return tail
+        for suffix in cls.WEIGHT_NAMES:
+            if tail.endswith("_" + suffix):
+                return tail
+        return None
+
+    def check(self, mod: ModuleContext) -> Iterator[Finding]:
+        patterns = mod.cfg.residency_funcs
+        for info in mod.functions:
+            if any(fnmatch.fnmatch(info.node.name, p)
+                   for p in patterns):
+                continue  # sanctioned staging path
+            # own body only: a nested def is its own FunctionInfo and
+            # is matched (or not) on its own name
+            own = {id(n): n for n in iter_own_nodes(info.node)}
+            flagged: set[int] = set()  # nested loops: report once
+            for loop in own.values():
+                if not isinstance(loop,
+                                  (ast.For, ast.AsyncFor, ast.While)):
+                    continue
+                for stmt in loop.body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call) \
+                                or id(sub) not in own \
+                                or id(sub) in flagged \
+                                or not sub.args:
+                            continue
+                        if last_attr(call_name(sub)) != "device_put":
+                            continue
+                        tail = self._weighty(sub.args[0])
+                        if tail is None:
+                            continue
+                        flagged.add(id(sub))
+                        yield mod.finding(
+                            sub, self.code,
+                            f"'jax.device_put({tail}, ...)' inside the "
+                            f"request loop of '{info.node.name}' "
+                            "re-uploads the weight pytree per request: "
+                            "weights are staged ONCE by the residency "
+                            "manager (TenancyManager.adopt / "
+                            "ensure_resident) and dispatch reads the "
+                            "resident edition — hoist the transfer out "
+                            "of the loop or route it through a "
+                            "residency_funcs-matched staging path")
+
+
 # concurrency tier (JX118-JX122, ISSUE 14): importing for registration
 # side effects keeps every "import checkers" site (run_paths, the CLI)
 # seeing the full checker set

@@ -294,6 +294,19 @@ class LintConfig:
         "*frame_loop*", "*session_loop*", "handle_stream*",
         "*stream_loop*", "serve_stream*",
     ])
+    # Function-name patterns treated as weight-residency managers
+    # (JX129): the tenancy layer (serve/tenancy.py) owns the ONE
+    # sanctioned path that stages weight pytrees onto the device —
+    # adopt / ensure_resident / rematerialize, amortized across
+    # requests behind the LRU budget. A ``jax.device_put`` of a
+    # weights/params/variables pytree inside a dispatch or request
+    # loop anywhere else re-uploads the full checkpoint per request
+    # (HBM churn + PCIe stall on the hot path); results stay correct,
+    # only the residency contract breaks.
+    residency_funcs: list[str] = field(default_factory=lambda: [
+        "*residency*", "*rematerialize*", "ensure_resident*",
+        "*stage_weights*", "adopt*",
+    ])
     disable: list[str] = field(default_factory=list)
     baseline: list[BaselineEntry] = field(default_factory=list)
 
@@ -316,6 +329,7 @@ def load_config(path: str | Path | None) -> LintConfig:
         "timed_funcs", "loop_sleep_funcs", "wire_funcs",
         "cluster_funcs", "sentinel_funcs", "span_funcs",
         "precision_funcs", "pipeline_funcs", "session_funcs",
+        "residency_funcs",
         "lock_name_patterns", "lock_blocking_calls", "collective_calls",
         "fork_unsafe_imports", "signal_safe_calls",
         "mesh_axis_names", "mesh_axis_home", "multidevice_dirs",
