@@ -16,8 +16,10 @@ writer (pid + monotonic counter) and commit with one atomic
 ``quarantine/`` (evidence, not deletion) while the caller falls back
 to trace-compile. Several replicas of one fleet can therefore share a
 ``--store DIR`` safely: concurrent writers each stage complete bytes,
-the last manifest replace wins with a valid file, and a writer killed
-mid-stage leaves only its own tmp file, which readers ignore.
+every manifest commit first folds in sibling entries it finds on disk
+(so one replica's commit does not orphan another's blobs; keys this
+process quarantined stay dead), and a writer killed mid-stage leaves
+only its own tmp file, which readers ignore.
 
 Concurrency shape (the JX119 contract): byte I/O never happens under
 ``_lock``. The in-process authority is an in-memory entries dict the
@@ -104,6 +106,10 @@ class ArtifactStore:
         self._entries = _load_manifest_entries(self._manifest_path)
         self._snap_seq = 0       # snapshot sequence, taken under _lock
         self._committed_seq = 0  # newest snapshot committed to disk
+        # tombstones: keys THIS process quarantined. The pre-commit
+        # merge of sibling replicas' on-disk entries must not
+        # resurrect them (a re-put with fresh bytes clears the stone).
+        self._removed: set[str] = set()
 
     # -- manifest ---------------------------------------------------------
     @property
@@ -121,12 +127,30 @@ class ArtifactStore:
         }
 
     def _commit_manifest(self, seq: int, manifest: dict) -> None:
-        """Stage the snapshot outside the lock, commit the atomic
-        replace under it — guarded so a slower writer holding an OLDER
-        snapshot can never clobber a newer committed one."""
+        """Merge, stage, commit. Replacing the whole entries dict
+        last-writer-wins would orphan blobs sibling fleet replicas
+        committed since our last look (their valid artifacts would
+        re-trace on every respawn), so entries on the shared on-disk
+        manifest that this process neither knows nor quarantined are
+        folded in first — then the snapshot stages through a
+        writer-unique tmp file and commits with one atomic
+        ``os.replace``, guarded so a slower writer holding an OLDER
+        snapshot can never clobber a newer committed one. The
+        cross-process merge is best-effort (no file lock); a commit
+        racing a sibling's is healed by the next merge, because the
+        adopted entries persist in ``_entries``."""
+        disk = _load_manifest_entries(self._manifest_path)
+        with self._lock:
+            if seq <= self._committed_seq:
+                return  # superseded before staging; nothing written
+            for k, v in disk.items():
+                if k not in self._entries and k not in self._removed:
+                    self._entries[k] = dict(v)
+                    manifest["entries"][k] = dict(v)
+            payload = json.dumps(manifest, indent=0, sort_keys=True)
         tmp = self._manifest_path.with_suffix(
             f".json.tmp.{os.getpid()}.{next(_tmp_seq)}")
-        tmp.write_text(json.dumps(manifest, indent=0, sort_keys=True))
+        tmp.write_text(payload)
         with self._lock:
             if seq > self._committed_seq:
                 os.replace(tmp, self._manifest_path)
@@ -163,6 +187,7 @@ class ArtifactStore:
                 "sha256": digest, "model": model, "bucket": int(bucket),
                 "dtype": dtype, "mesh": mesh, "fingerprint": fingerprint,
             }
+            self._removed.discard(key)  # fresh bytes revive the key
             self.puts += 1
             seq, manifest = self._snapshot_locked()
         self._commit_manifest(seq, manifest)
@@ -187,6 +212,7 @@ class ArtifactStore:
                 return None
             with self._lock:
                 want = self._entries.setdefault(key, dict(disk))
+                self._removed.discard(key)  # sibling re-published it
         path = self.root / want.get("file", "")
         try:
             data = path.read_bytes()
@@ -235,6 +261,7 @@ class ArtifactStore:
             shutil.move(str(src), str(target))
         with self._lock:
             self._entries.pop(key, None)
+            self._removed.add(key)  # merge must not resurrect it
             self.quarantined += 1
             seq, manifest = self._snapshot_locked()
         self._commit_manifest(seq, manifest)

@@ -364,6 +364,22 @@ class InferenceEngine:
                             lambda m=m, bucket=bucket: m.compile_for(
                                 bucket, self._mesh),
                         )
+        if self._store is not None:
+            # a tenant whose ENTIRE ladder deserialized from the store
+            # serves programs with the weights baked in as constants:
+            # nothing reads its edition at call time, so the adopted
+            # device copy is released to host and the tenant leaves
+            # the residency budget's LRU (an eviction could not free
+            # baked constants anyway). Partially store-warmed models
+            # keep their edition resident — their trace-compiled
+            # buckets read it. A later hot-swap compiles edition-
+            # backed runners and re-enters residency management.
+            for m in self._models.values():
+                if not self._storeable(m):
+                    continue
+                keys = [self._model_key(m, b) for b in self.ladder(m)]
+                if all(k in self._from_store for k in keys):
+                    self._tenancy.release_to_baked(m, len(keys))
         self.warmup_s = round(time.perf_counter() - t0, 3)
 
     def _storeable(self, m) -> bool:
@@ -492,6 +508,16 @@ class InferenceEngine:
             served, variables, ladder=self.ladder(served),
             mesh=self._mesh, cache=self._cache,
             key_fn=self._model_key)
+        if result.get("unchanged"):
+            # same-fingerprint swap: the live ladder already pairs
+            # with these exact bytes — nothing installed, nothing
+            # dropped, nothing to re-export
+            return result
+        if self._from_store:
+            # the swap dropped any store-warmed (baked-weights)
+            # runners for this tenant; stats must stop claiming them
+            self._from_store = {
+                k for k in self._from_store if k[0] != name}
         if self._store is not None and self._storeable(served):
             # keep the store current: a replica respawned after the
             # swap warms the NEW fingerprint from disk

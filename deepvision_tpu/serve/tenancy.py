@@ -23,6 +23,21 @@ tenancy story at once:
   fingerprint, so the flip is a cache *miss* into the freshly
   installed entries, never a stale hit.
 
+A swap whose new weights fingerprint equals the current one (a
+retried swap, re-restoring the same checkpoint) is a loud no-op:
+running the flip would drop the live runners it just installed, since
+old and new key identically.
+
+Two kinds of HBM sit outside the budget's reach and are surfaced in
+:meth:`TenancyManager.stats` instead of silently under-counted:
+**baked** tenants (every executable warmed from the artifact store
+with weights baked in as program constants — the unused edition
+device copy is released to host) and **retired** editions (pre-swap
+weight generations still pinned by compiled runners, e.g. a pipeline
+stage serving its compile-time weights until re-registered; these DO
+count in :meth:`TenancyManager.resident_bytes` for as long as they
+are held).
+
 Per-tenant isolation (admission quotas, SLO classes, shed accounting)
 lives in ``admission.AdmissionController`` — the engine and
 ``FleetRouter`` thread tenant maps through it so one noisy tenant
@@ -33,6 +48,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import weakref
 from collections import OrderedDict
 from typing import Any
 
@@ -78,7 +94,11 @@ class WeightsEdition:
     re-materialize) retargets every cached executable at once, while a
     swap — a *new* edition — retargets none of them."""
 
-    __slots__ = ("variables", "fingerprint", "nbytes", "resident")
+    # __weakref__: retired editions (pre-swap generations still pinned
+    # by compiled runners, e.g. a pipeline stage) are tracked weakly so
+    # stats can report their HBM for exactly as long as it is held
+    __slots__ = ("variables", "fingerprint", "nbytes", "resident",
+                 "__weakref__")
 
     def __init__(self, variables, fingerprint: str, nbytes: int,
                  *, resident: bool):
@@ -113,6 +133,17 @@ class TenancyManager:
         self._swap_lock = threading.Lock()
         self._tenants: dict[str, Any] = {}
         self._lru: OrderedDict[str, None] = OrderedDict()
+        # tenants whose ENTIRE ladder came off the artifact store with
+        # weights baked in as program constants: name -> estimated
+        # baked bytes. Nothing reads their edition at call time, so
+        # they sit outside LRU residency (there is nothing a budget
+        # eviction could free) but their HBM is surfaced in stats().
+        self._baked: dict[str, int] = {}
+        # swapped-out editions possibly still pinned by compiled
+        # runners (pipeline DAG stages keep their compile-time
+        # edition until re-registered): (tenant, weakref) pairs,
+        # pruned as the last runner over each edition is released
+        self._retired: list[tuple[str, weakref.ref]] = []
         self.swaps = 0
         self.evictions = 0
         self.rematerializations = 0
@@ -155,7 +186,11 @@ class TenancyManager:
         host. Cheap when already resident (dict touch under lock)."""
         with self._lock:
             served = self._tenants.get(name)
-            if served is None or served.edition is None:
+            if served is None or served.edition is None \
+                    or name in self._baked:
+                # baked tenants: every executable carries its weights
+                # as constants — re-staging the edition copy would be
+                # pure HBM waste
                 return
             if not served.edition.resident:
                 self._rematerialize(served)
@@ -196,11 +231,64 @@ class TenancyManager:
                       flush=True)
             return True
 
-    def resident_bytes(self) -> int:
+    def release_to_baked(self, served, n_programs: int) -> None:
+        """Take a tenant whose ENTIRE bucket ladder was warmed from
+        the artifact store out of edition residency. Store blobs are
+        serialized programs with the weights baked in as constants —
+        no runner reads the edition at call time — so the edition's
+        separate device copy is freed to host and the tenant leaves
+        the LRU (a budget eviction could not reclaim baked constants
+        anyway). The baked copies' HBM (~weights bytes × programs) is
+        recorded so ``stats()`` reports what the residency budget
+        cannot govern instead of silently under-counting. A later
+        hot-swap pre-compiles edition-backed runners and returns the
+        tenant to normal residency management."""
         with self._lock:
-            return sum(
+            ed = getattr(served, "edition", None)
+            if ed is None:
+                return
+            if ed.resident:
+                import jax
+
+                ed.variables = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a), ed.variables)
+                served.variables = ed.variables
+                ed.resident = False
+            self._lru.pop(served.name, None)
+            self._baked[served.name] = ed.nbytes * n_programs
+            self._log(
+                f"[tenancy] {served.name}: all {n_programs} executables "
+                f"store-warmed (weights baked in); released edition "
+                f"device copy ({ed.nbytes}B), baked "
+                f"~{ed.nbytes * n_programs}B outside residency budget",
+                flush=True)
+
+    def resident_bytes(self) -> int:
+        """Device bytes of weight editions: every current resident
+        edition plus retired (swapped-out) editions still pinned by
+        live runners. Baked store-warmed programs are outside the
+        budget's reach and accounted separately
+        (``stats()['baked_bytes']``)."""
+        with self._lock:
+            current = sum(
                 t.edition.nbytes for t in self._tenants.values()
                 if t.edition is not None and t.edition.resident)
+            pinned = sum(ed.nbytes for _n, ed in self._live_retired()
+                         if ed.resident)
+            return current + pinned
+
+    def _live_retired(self) -> list[tuple[str, Any]]:
+        """(tenant, edition) for swapped-out editions some compiled
+        runner still holds; dead weakrefs prune on the way past.
+        Caller must hold ``_lock``."""
+        kept, out = [], []
+        for name, ref in self._retired:
+            ed = ref()
+            if ed is not None:
+                kept.append((name, ref))
+                out.append((name, ed))
+        self._retired = kept
+        return out
 
     def _evict_over_budget(self, *, protect: str | None = None) -> None:
         if self._budget is None:
@@ -228,6 +316,20 @@ class TenancyManager:
         with self._swap_lock:
             old_fp = served.weights_fingerprint()
             fp = fingerprint_variables(new_variables)
+            if fp == old_fp:
+                # retried swap / workdir= re-restore of the same
+                # checkpoint: the installed ladder already pairs with
+                # exactly these bytes. Re-running the flip would be
+                # churn, and dropping the "old" fingerprint would
+                # delete the LIVE runners (old == new) — on a frozen
+                # cache every later request would then die on the
+                # miss tripwire. No-op, loudly.
+                self._log(f"[tenancy] swap {served.name}: fingerprint "
+                          f"{fp} unchanged; no-op", flush=True)
+                return {"model": served.name, "fingerprint": fp,
+                        "old_fingerprint": old_fp,
+                        "buckets": [int(b) for b in ladder],
+                        "dropped_executables": 0, "unchanged": True}
             new_ed = WeightsEdition(
                 self._stage_weights(new_variables), fp,
                 tree_nbytes(new_variables), resident=True)
@@ -241,11 +343,22 @@ class TenancyManager:
                 runners[key_fn(shadow, bucket)] = shadow.compile_for(
                     bucket, mesh)
             with self._lock:
+                if served.edition is not None:
+                    # the old edition may outlive the flip (pipeline
+                    # DAG runners compiled against it keep serving it
+                    # until re-registered): track it weakly so
+                    # stats/resident_bytes keep counting that HBM for
+                    # as long as some runner pins it
+                    self._retired.append(
+                        (served.name, weakref.ref(served.edition)))
                 for key, runner in runners.items():
                     cache.install(key, runner)
                 served.edition = new_ed
                 served.variables = new_ed.variables
                 served._fingerprint = fp
+                # edition-backed from here on, even if the pre-swap
+                # ladder was baked store programs
+                self._baked.pop(served.name, None)
                 self._lru[served.name] = None
                 self._lru.move_to_end(served.name)
                 self.swaps += 1
@@ -256,7 +369,11 @@ class TenancyManager:
             dropped = cache.drop_where(
                 lambda k: k[0] == served.name and len(k) > 3
                 and k[3] == old_fp)
-            self._evict_over_budget(protect=served.name)
+            with self._lock:
+                # under the residency lock: the dispatcher mutates
+                # _lru concurrently in ensure_resident, and the
+                # eviction scan must not see a torn view
+                self._evict_over_budget(protect=served.name)
             self._log(f"[tenancy] swapped {served.name}: {old_fp} -> "
                       f"{fp} ({len(runners)} buckets, "
                       f"{dropped} stale executables dropped)", flush=True)
@@ -275,6 +392,18 @@ class TenancyManager:
                              and t.edition.resident],
                 "resident_bytes": self.resident_bytes(),
                 "budget_bytes": self._budget,
+                # HBM the budget cannot govern, surfaced instead of
+                # silently under-counted: store-warmed tenants whose
+                # weights are baked into their programs, and
+                # swapped-out editions still pinned by live runners
+                # (e.g. a pipeline stage serving its compile-time
+                # weights until re-registered)
+                "baked": sorted(self._baked),
+                "baked_bytes": sum(self._baked.values()),
+                "retired_pinned": [
+                    {"tenant": n, "fingerprint": ed.fingerprint,
+                     "nbytes": ed.nbytes}
+                    for n, ed in self._live_retired() if ed.resident],
                 "swaps": self.swaps,
                 "evictions": self.evictions,
                 "rematerializations": self.rematerializations,

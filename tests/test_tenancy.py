@@ -177,6 +177,59 @@ def test_concurrent_hot_swaps_serialize_to_final_weights():
             tuple(expected_toy(x, 7.0).tolist())}
 
 
+def test_same_fingerprint_swap_is_noop_and_keeps_serving():
+    """A retried swap (or re-restoring the same checkpoint) hashes to
+    the SAME fingerprint: running the drop would delete the live
+    runners (old key == new key) and — on a frozen cache — every later
+    request would die on the miss tripwire. It must no-op instead."""
+    with make_engine([toy_model("a", 2.0)], freeze_cache=True) as eng:
+        x = np.ones(3, np.float32)
+        keys = [eng._model_key(eng._models["a"], b) for b in (1, 4)]
+        res = eng.hot_swap("a", {"w": np.float32(2.0)})  # same bytes
+        assert res["unchanged"] is True
+        assert res["fingerprint"] == res["old_fingerprint"]
+        assert res["dropped_executables"] == 0
+        assert eng.tenancy.swaps == 0  # not counted as a swap
+        for k in keys:
+            assert eng._cache.contains(k)  # live runners NOT dropped
+        r = eng.submit(x, model="a").result(timeout=30)
+        np.testing.assert_array_equal(r["y"], expected_toy(x, 2.0))
+
+
+def test_retried_swap_after_real_swap_is_noop():
+    with make_engine([toy_model("a", 2.0)], freeze_cache=True) as eng:
+        x = np.ones(3, np.float32)
+        eng.hot_swap("a", {"w": np.float32(5.0)})
+        res = eng.hot_swap("a", {"w": np.float32(5.0)})  # the retry
+        assert res["unchanged"] is True
+        assert eng.tenancy.swaps == 1
+        r = eng.submit(x, model="a").result(timeout=30)
+        np.testing.assert_array_equal(r["y"], expected_toy(x, 5.0))
+
+
+def test_swap_surfaces_editions_pinned_by_live_runners():
+    """A runner that outlives the swap (a pipeline DAG stage, in
+    production) pins the old edition's device buffers: stats must
+    count that HBM for exactly as long as it is held."""
+    import gc
+
+    with make_engine([toy_model("a", 2.0)]) as eng:
+        served = eng._models["a"]
+        x = np.ones(3, np.float32)
+        eng.submit(x, model="a").result(timeout=30)
+        pinned = eng._bucket_runner(served, 1)  # stands in for a DAG
+        old_nbytes = served.edition.nbytes
+        eng.hot_swap("a", {"w": np.float32(5.0)})
+        st = eng.tenancy.stats()
+        assert [p["tenant"] for p in st["retired_pinned"]] == ["a"]
+        assert st["resident_bytes"] == old_nbytes + served.edition.nbytes
+        del pinned  # the last runner over the old edition goes away
+        gc.collect()
+        st = eng.tenancy.stats()
+        assert st["retired_pinned"] == []
+        assert st["resident_bytes"] == served.edition.nbytes
+
+
 def test_hot_swap_rejects_artifacts_and_bad_args():
     with make_engine([toy_model("a", 2.0)]) as eng:
         with pytest.raises(ValueError, match="unknown model"):
@@ -335,6 +388,80 @@ def test_store_keys_include_fingerprint_and_swap_exports_new(tmp_path):
         x = np.ones(3, np.float32)
         r = eng2.submit(x, model="a").result(timeout=30)
         np.testing.assert_array_equal(r["y"], expected_toy(x, 6.0))
+
+
+def test_store_warmed_tenant_releases_edition_copy(tmp_path):
+    """Store-warmed runners carry their weights baked in as program
+    constants and never read the edition — the adopted device copy is
+    released to host, the tenant leaves the residency LRU, and the
+    baked HBM is surfaced in stats. A real hot-swap returns the tenant
+    to edition-backed residency."""
+    store = tmp_path / "aot"
+    x = np.ones(3, np.float32)
+    with make_engine([toy_model("a", 2.0)], store=str(store)) as eng:
+        r1 = eng.submit(x, model="a").result(timeout=30)
+    with make_engine([toy_model("a", 2.0)], store=str(store)) as eng2:
+        st = eng2.tenancy.stats()
+        assert st["baked"] == ["a"]
+        assert st["baked_bytes"] == 8  # 4B weights × 2 baked programs
+        assert st["resident"] == []  # separate device copy released
+        assert eng2.tenancy.resident_bytes() == 0
+        r2 = eng2.submit(x, model="a").result(timeout=30)
+        assert r2 == r1
+        # dispatch never re-stages the unused edition copy
+        assert eng2.tenancy.stats()["rematerializations"] == 0
+        # a swap pre-compiles edition-backed runners: back under the
+        # residency budget, and no longer claimed as store-warmed
+        eng2.hot_swap("a", {"w": np.float32(5.0)})
+        st = eng2.tenancy.stats()
+        assert st["baked"] == []
+        assert st["resident"] == ["a"]
+        assert eng2.stats()["warmed_from_store"] == []
+        r3 = eng2.submit(x, model="a").result(timeout=30)
+        np.testing.assert_array_equal(r3["y"], expected_toy(x, 5.0))
+
+
+def test_manifest_commit_merges_sibling_replica_entries(tmp_path):
+    """Fleet sharing: one replica's manifest commit must not orphan
+    blobs other replicas committed since its last look — a fresh
+    respawn over the shared store sees everyone's entries."""
+    from deepvision_tpu.serve import ArtifactStore
+
+    quiet = dict(log=lambda *a, **k: None)
+    a = ArtifactStore(tmp_path / "aot", **quiet)
+    b = ArtifactStore(tmp_path / "aot", **quiet)
+    kw = dict(bucket=1, dtype="float32", mesh="cpu:data=1",
+              fingerprint="f")
+    a.put(b"aaa", model="ma", **kw)
+    b.put(b"bbb", model="mb", **kw)  # must not clobber a's entry
+    a.put(b"ccc", model="mc", **kw)  # must not clobber b's entry
+    fresh = ArtifactStore(tmp_path / "aot", **quiet)
+    assert fresh.get(model="ma", **kw) == b"aaa"
+    assert fresh.get(model="mb", **kw) == b"bbb"
+    assert fresh.get(model="mc", **kw) == b"ccc"
+
+
+def test_quarantined_key_not_resurrected_by_sibling_merge(tmp_path):
+    from deepvision_tpu.serve import ArtifactStore
+
+    quiet = dict(log=lambda *a, **k: None)
+    a = ArtifactStore(tmp_path / "aot", **quiet)
+    b = ArtifactStore(tmp_path / "aot", **quiet)
+    kw = dict(model="m", bucket=1, dtype="float32", mesh="cpu:data=1",
+              fingerprint="f")
+    b.put(b"payload", **kw)
+    blob = next((tmp_path / "aot" / "blobs").rglob("*.stablehlo"))
+    blob.write_bytes(b"corrupt!")
+    assert a.get(**kw) is None  # quarantines + commits a manifest
+    # a's next commit merges b's on-disk entries — but the key a just
+    # quarantined stays dead instead of resurrecting as a known-bad
+    # entry every future reader re-quarantines
+    a.put(b"other", model="m2", bucket=1, dtype="float32",
+          mesh="cpu:data=1", fingerprint="f")
+    fresh = ArtifactStore(tmp_path / "aot", **quiet)
+    assert fresh.get(**kw) is None
+    assert fresh.get(model="m2", bucket=1, dtype="float32",
+                     mesh="cpu:data=1", fingerprint="f") == b"other"
 
 
 def test_store_put_is_idempotent_and_manifest_survives_garbage(
